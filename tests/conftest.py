@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (the driver separately
+dry-runs `__graft_entry__.dryrun_multichip`)."""
+import os
+
+# Force, not setdefault: the machine environment pre-sets the experimental
+# axon TPU-tunnel platform, which must never be touched from the test suite.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon plugin (injected via sitecustomize on this image) registers a
+# backend factory whose PJRT client dials a TPU tunnel during backends()
+# initialization — even under JAX_PLATFORMS=cpu — and hangs the whole
+# suite if the tunnel is wedged.  Drop the factory before any backend is
+# initialized; tests are CPU-only by design.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,217 @@
+"""Operator kernel semantics: numeric unit-space ops and permutation
+crossovers, property-tested against the reference's documented behavior
+(manipulator.py:505-542, 1048-1357)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uptune_tpu.ops import numeric, perm
+
+
+def valid_perm_rows(pm):
+    pm = np.asarray(pm)
+    n = pm.shape[1]
+    return all(sorted(r.tolist()) == list(range(n)) for r in pm)
+
+
+# ---------------- numeric ----------------
+
+def test_reflect_unit():
+    v = jnp.array([-0.25, 0.0, 0.5, 1.0, 1.25, 1.9])
+    out = np.asarray(numeric.reflect_unit(v))
+    np.testing.assert_allclose(out, [0.25, 0.0, 0.5, 1.0, 0.75, 0.1],
+                               atol=1e-6)
+    assert ((out >= 0) & (out <= 1)).all()
+
+
+def test_normal_mutation_bounds_and_masks():
+    key = jax.random.PRNGKey(0)
+    u = jnp.full((64, 6), 0.5)
+    cm = jnp.array([False, False, False, True, True, True])
+    out = numeric.normal_mutation(key, u, 0.1, cm)
+    out_np = np.asarray(out)
+    assert ((out_np >= 0) & (out_np <= 1)).all()
+    # complex lanes are uniform redraws: spread over [0,1], not near 0.5
+    assert out_np[:, 3:].std() > 0.2
+    # primitive lanes stay near 0.5 with sigma=0.1
+    assert abs(out_np[:, :3].mean() - 0.5) < 0.05
+    # with a mask, unmasked lanes unchanged
+    m = jnp.zeros((64, 6), bool).at[:, 0].set(True)
+    out2 = np.asarray(numeric.normal_mutation(key, u, 0.1, cm, mask=m))
+    np.testing.assert_array_equal(out2[:, 1:], 0.5)
+    assert (out2[:, 0] != 0.5).any()
+
+
+def test_set_linear_primitive_and_complex():
+    key = jax.random.PRNGKey(1)
+    B, D = 16, 4
+    cm = jnp.array([False, False, True, True])
+    ua = jnp.full((B, D), 0.2)
+    ub = jnp.full((B, D), 0.6)
+    uc = jnp.full((B, D), 0.4)
+    # codes equal on lane 2, differ on lane 3
+    eq = jnp.tile(jnp.array([True, True, True, False]), (B, 1))
+    out = np.asarray(numeric.set_linear(
+        key, ua, ub, uc, 1.0, 0.5, -0.5, cm, eq))
+    # primitive: 0.2 + 0.5*(0.6-0.4) = 0.3
+    np.testing.assert_allclose(out[:, :2], 0.3, atol=1e-6)
+    # complex equal codes: copy ua
+    np.testing.assert_allclose(out[:, 2], 0.2, atol=1e-6)
+    # complex differing codes: random redraw (not a constant)
+    assert out[:, 3].std() > 0.05
+
+
+def test_set_linear_clips():
+    key = jax.random.PRNGKey(2)
+    one = jnp.ones((4, 2))
+    cm = jnp.zeros(2, bool)
+    eq = jnp.ones((4, 2), bool)
+    out = np.asarray(numeric.set_linear(key, one, one, one * 0.0,
+                                        1.0, 1.0, -0.0, cm, eq))
+    assert (out <= 1.0).all()
+
+
+def test_swarm_moves_toward_best():
+    key = jax.random.PRNGKey(3)
+    u = jnp.full((256, 2), 0.1)
+    best = jnp.full((256, 2), 0.9)
+    vel = jnp.zeros((256, 2))
+    cm = jnp.zeros(2, bool)
+    bm = jnp.zeros(2, bool)
+    out, v = numeric.swarm(key, u, best, best, vel, cm, bm)
+    assert np.asarray(out).mean() > 0.15  # moved toward 0.9 on average
+    assert np.asarray(v).mean() > 0
+
+
+def test_swarm_complex_lanes_mix_parents():
+    # SWITCH/ENUM lanes must stochastically pick among current/local/global
+    # values — never snap to the unit endpoints (which would make middle
+    # options unreachable).
+    key = jax.random.PRNGKey(9)
+    u = jnp.full((512, 2), 0.5)
+    loc = jnp.full((512, 2), 0.3)
+    glob = jnp.full((512, 2), 0.7)
+    cm = jnp.array([True, True])
+    bm = jnp.array([True, False])  # lane 0 bool, lane 1 enum-like
+    out, _ = numeric.swarm(key, u, loc, glob, jnp.zeros((512, 2)), cm, bm)
+    o = np.asarray(out)
+    assert set(np.unique(o[:, 0]).tolist()) <= {0.0, 1.0}  # bool coin
+    uniq = np.unique(o[:, 1])
+    assert all(min(abs(float(v) - t) for t in (0.3, 0.5, 0.7)) < 1e-6
+               for v in uniq)
+    assert len(uniq) == 3                                  # all parents reachable
+
+
+# ---------------- permutation ----------------
+
+N = 8
+
+
+def rand_perms(key, b=32, n=N):
+    return jax.vmap(lambda k: jax.random.permutation(k, n))(
+        jax.random.split(key, b)).astype(jnp.int32)
+
+
+def test_shuffle_and_swap_valid():
+    key = jax.random.PRNGKey(4)
+    pm = rand_perms(key)
+    assert valid_perm_rows(perm.shuffle_batch(key, pm))
+    out = perm.random_swap_batch(key, pm)
+    assert valid_perm_rows(out)
+    # exactly 0 or 2 positions differ per row
+    diff = (np.asarray(out) != np.asarray(pm)).sum(axis=1)
+    assert set(diff.tolist()) <= {0, 2}
+
+
+def test_random_invert():
+    key = jax.random.PRNGKey(5)
+    pm = rand_perms(key)
+    out = perm.random_invert_batch(key, pm, 3)
+    assert valid_perm_rows(out)
+    diff = (np.asarray(out) != np.asarray(pm)).sum(axis=1)
+    assert diff.max() <= 3
+
+
+def test_small_random_change_matches_reference_bubble():
+    # reference: iterate i=1..n-1, swap (i-1, i) with prob p on the *updated*
+    # list (manipulator.py:1067-1080)
+    key = jax.random.PRNGKey(6)
+    p0 = jnp.arange(N, dtype=jnp.int32)
+    out = perm.small_random_change(key, p0, 1.0)  # always swap
+    # with p=1 element 0 bubbles to the end
+    assert np.asarray(out).tolist() == [1, 2, 3, 4, 5, 6, 7, 0]
+
+
+@pytest.mark.parametrize("name", ["PX", "PMX", "CX", "OX1", "OX3"])
+def test_crossovers_produce_valid_perms(name):
+    key = jax.random.PRNGKey(7)
+    k1, k2, k3 = jax.random.split(key, 3)
+    p1 = rand_perms(k1)
+    p2 = rand_perms(k2)
+    fn = getattr(perm, f"cross_{name.lower()}_batch")
+    out = fn(k3, p1, p2, 3)
+    assert valid_perm_rows(out)
+
+
+def test_px_semantics():
+    # head of p1 (up to some cut c in [2, n]) reordered to p2's order
+    # (ascending here); tail keeps p1's order
+    p1 = np.array([3, 1, 0, 2, 4, 7, 6, 5])
+    p2 = jnp.arange(N, dtype=jnp.int32)
+    seen_cuts = set()
+    for seed in range(16):
+        out = np.asarray(perm.cross_px(
+            jax.random.PRNGKey(seed), jnp.asarray(p1, jnp.int32), p2))
+        assert sorted(out.tolist()) == list(range(N))
+        # the result must equal sorted(p1[:c]) + p1[c:] for some c in [2, n]
+        matches = [c for c in range(2, N + 1)
+                   if out.tolist() == sorted(p1[:c].tolist()) + p1[c:].tolist()]
+        assert matches, out
+        seen_cuts.add(matches[0])
+    assert len(seen_cuts) > 1  # cut point actually varies
+
+
+def test_pmx_segment_copied():
+    key = jax.random.PRNGKey(8)
+    p1 = jnp.arange(N, dtype=jnp.int32)
+    p2 = jnp.array([7, 6, 5, 4, 3, 2, 1, 0], jnp.int32)
+    out = np.asarray(perm.cross_pmx(key, p1, p2, 3))
+    # some window of length 3 must equal p2's window at the same positions
+    found = any(np.array_equal(out[r:r + 3], np.asarray(p2)[r:r + 3])
+                for r in range(N - 2))
+    assert found and sorted(out.tolist()) == list(range(N))
+
+
+def test_cx_takes_cycle_from_p2():
+    p1 = jnp.array([1, 2, 3, 0, 4, 5, 6, 7], jnp.int32)  # cycle (0 1 2 3)
+    p2 = jnp.arange(N, dtype=jnp.int32)
+    out = np.asarray(perm.cross_cx(jax.random.PRNGKey(0), p1, p2))
+    assert sorted(out.tolist()) == list(range(N))
+    # positions on the chosen cycle take p2's values, others keep p1's;
+    # since p1 differs from p2 only on the 4-cycle, out is one of the two
+    assert (np.array_equal(out, np.asarray(p1)) or
+            np.array_equal(out, np.asarray(p2)))
+
+
+def test_ox1_inserts_p2_window_in_order():
+    p1 = jnp.arange(N, dtype=jnp.int32)
+    p2 = jnp.array([7, 6, 5, 4, 3, 2, 1, 0], jnp.int32)
+    out = np.asarray(perm.cross_ox1(jax.random.PRNGKey(1), p1, p2, 3))
+    assert sorted(out.tolist()) == list(range(N))
+    # a length-3 descending run from p2 must appear contiguously
+    runs = [out[i:i + 3] for i in range(N - 2)]
+    assert any((r[0] - 1 == r[1]) and (r[1] - 1 == r[2]) for r in runs)
+
+
+def test_toposort_batch():
+    # item1 requires item0 earlier; item2 requires item1
+    dep = jnp.array([[0, 0, 0], [1, 0, 0], [0, 1, 0]], dtype=bool)
+    pm = jnp.array([[2, 1, 0], [0, 1, 2], [1, 0, 2]], jnp.int32)
+    out = np.asarray(perm.toposort_batch(pm, dep))
+    for row in out:
+        assert row.tolist() == [0, 1, 2]
+    # stability: with no deps, order preserved
+    nodep = jnp.zeros((3, 3), bool)
+    out2 = np.asarray(perm.toposort_batch(pm, nodep))
+    np.testing.assert_array_equal(out2, np.asarray(pm))

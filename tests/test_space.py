@@ -1,0 +1,142 @@
+"""Space encoding/decoding semantics, mirroring the reference's unit-value
+contracts (manipulator.py:473-503, 651-836) on the flat device encoding."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uptune_tpu.space import (
+    BoolParam, CandBatch, EnumParam, FloatParam, IntParam, LogFloatParam,
+    LogIntParam, PermParam, Pow2Param, ScheduleParam, Space, SwitchParam,
+    infer_param,
+)
+
+
+def small_space():
+    return Space([
+        FloatParam("f", 0.0, 10.0),
+        IntParam("i", 1, 9),
+        LogIntParam("li", 1, 1024),
+        LogFloatParam("lf", 0.001, 1000.0),
+        Pow2Param("p2", 2, 256),
+        BoolParam("b"),
+        SwitchParam("sw", 5),
+        EnumParam("e", options=("a", "b", "c")),
+        PermParam("perm", items=(0, 1, 2, 3, 4)),
+    ])
+
+
+def test_shapes_and_masks():
+    sp = small_space()
+    assert sp.n_scalar == 8
+    assert sp.perm_sizes == (5,)
+    assert np.asarray(sp.complex_mask).tolist() == [
+        False, False, False, False, False, True, True, True]
+
+
+def test_decode_endpoints_and_rounding():
+    sp = small_space()
+    lo = sp.decode_scalars(jnp.zeros((1, 8)))[0]
+    hi = sp.decode_scalars(jnp.ones((1, 8)))[0]
+    np.testing.assert_allclose(lo[0], 0.0, atol=1e-5)       # float lo
+    np.testing.assert_allclose(hi[0], 10.0, atol=1e-5)      # float hi
+    assert lo[1] == 1 and hi[1] == 9                         # int clamped
+    assert lo[2] == 1 and hi[2] == 1024                      # log int
+    np.testing.assert_allclose(lo[3], 0.001, rtol=1e-3)      # log float lo
+    np.testing.assert_allclose(hi[3], 1000.0, rtol=1e-3)     # log float hi
+    assert lo[4] == 2 and hi[4] == 256                       # pow2 values
+    assert lo[5] == 0 and hi[5] == 1                         # bool codes
+    assert lo[6] == 0 and hi[6] == 4                         # switch codes
+    assert lo[7] == 0 and hi[7] == 2                         # enum codes
+
+
+def test_int_rounding_uniformity():
+    # unit->int decode must cover endpoints with the same width as interior
+    # values (the +-0.4999 widening of manipulator.py:477-480).
+    sp = Space([IntParam("i", 0, 3)])
+    u = jnp.linspace(0.0, 1.0, 4001)[:, None]
+    vals = np.asarray(sp.decode_scalars(u))[:, 0]
+    counts = [int((vals == v).sum()) for v in range(4)]
+    assert min(counts) > 0.8 * max(counts), counts
+
+
+def test_pow2_decode_is_power_of_two():
+    sp = Space([Pow2Param("p", 4, 64)])
+    u = jax.random.uniform(jax.random.PRNGKey(0), (256, 1))
+    vals = np.asarray(sp.decode_scalars(u))[:, 0]
+    assert set(np.unique(vals)) <= {4.0, 8.0, 16.0, 32.0, 64.0}
+
+
+def test_encode_decode_roundtrip_configs():
+    sp = small_space()
+    cands = sp.random(jax.random.PRNGKey(1), 32)
+    cfgs = sp.to_configs(cands)
+    back = sp.from_configs(cfgs)
+    cfgs2 = sp.to_configs(back)
+    for a, b in zip(cfgs, cfgs2):
+        for k in a:
+            if isinstance(a[k], float):
+                assert a[k] == pytest.approx(b[k], rel=1e-3), k
+            else:
+                assert a[k] == b[k], k
+
+
+def test_random_perms_valid():
+    sp = small_space()
+    cands = sp.random(jax.random.PRNGKey(2), 64)
+    pm = np.asarray(cands.perms[0])
+    for row in pm:
+        assert sorted(row.tolist()) == [0, 1, 2, 3, 4]
+    # not all identical
+    assert len({tuple(r) for r in pm.tolist()}) > 10
+
+
+def test_hash_consistency_and_spread():
+    sp = small_space()
+    cands = sp.random(jax.random.PRNGKey(3), 128)
+    h1 = np.asarray(sp.hash_batch(cands))
+    h2 = np.asarray(sp.hash_batch(cands))
+    np.testing.assert_array_equal(h1, h2)
+    pairs = {tuple(r) for r in h1.tolist()}
+    assert len(pairs) == 128  # no collisions in a random batch
+    # configs that decode identically hash identically even if raw unit
+    # values differ (integer lanes quantize)
+    spi = Space([IntParam("i", 0, 3)])
+    ca = CandBatch(jnp.array([[0.50], [0.52]]), ())
+    ha = np.asarray(spi.hash_batch(ca))
+    assert tuple(ha[0]) == tuple(ha[1])
+
+
+def test_search_space_size():
+    sp = Space([IntParam("i", 1, 9), BoolParam("b"),
+                PermParam("p", items=tuple(range(5)))])
+    assert sp.search_space_size() == 9 * 2 * math.factorial(5)
+
+
+def test_schedule_param_normalize():
+    # b depends on a; c depends on b (transitively on a)
+    sp = Space([ScheduleParam("s", items=("a", "b", "c"),
+                              deps=(("b", ("a",)), ("c", ("b",))))])
+    cands = sp.random(jax.random.PRNGKey(4), 16)
+    for cfg in sp.to_configs(cands):
+        order = cfg["s"]
+        assert order.index("a") < order.index("b") < order.index("c")
+
+
+def test_infer_param():
+    assert isinstance(infer_param("x", 3, (1, 9)), IntParam)
+    assert isinstance(infer_param("x", 0.5, (0.0, 1.0)), FloatParam)
+    assert isinstance(infer_param("x", True, (True, False)), BoolParam)
+    e = infer_param("x", "a", ["a", "b"])
+    assert isinstance(e, EnumParam) and e.options == ("a", "b")
+    p = infer_param("x", [0, 1, 2], [0, 1, 2])
+    assert isinstance(p, PermParam)
+
+
+def test_seed_default():
+    sp = small_space()
+    cfgs = sp.to_configs(sp.seed_default(2))
+    assert cfgs[0]["i"] == 1 and cfgs[0]["p2"] == 2
+    assert cfgs[0]["perm"] == [0, 1, 2, 3, 4]

@@ -1,0 +1,58 @@
+"""uptune-tpu: a TPU-native distributed auto-tuning framework.
+
+A ground-up JAX/XLA re-design of the capabilities of Hecmay/uptune
+(reference at /root/reference): mixed discrete/continuous/permutation search
+spaces, an ensemble of search techniques under an AUC multi-armed bandit,
+surrogate-model pruning, and distributed black-box evaluation — with the
+entire proposal side (population state, mutation/crossover operators,
+surrogate fit, acquisition scoring, dedup) living on TPU as batched kernels
+over flat device arrays.
+
+The full user-facing facade (`ut.tune`, `ut.target`, `ut.config`, ...) is
+assembled lazily in `uptune_tpu.api`; the core layers are importable
+directly:
+
+    from uptune_tpu.space import Space, FloatParam
+    from uptune_tpu import techniques, driver
+"""
+__version__ = "0.1.0"
+
+_LAZY = {
+    # public name -> (module, attribute)
+    "tune": ("uptune_tpu.api.tuneapi", "tune"),
+    "target": ("uptune_tpu.api.report", "target"),
+    "interm": ("uptune_tpu.api.report", "interm"),
+    "feature": ("uptune_tpu.api.report", "feature"),
+    "save": ("uptune_tpu.api.report", "save"),
+    "get_global_id": ("uptune_tpu.api.report", "get_global_id"),
+    "get_local_id": ("uptune_tpu.api.report", "get_local_id"),
+    "get_meta_data": ("uptune_tpu.api.report", "get_meta_data"),
+    "config": ("uptune_tpu.api.session", "config"),
+    "init": ("uptune_tpu.api.session", "init"),
+    "get_best": ("uptune_tpu.api.session", "get_best"),
+    "rule": ("uptune_tpu.api.constraint", "rule"),
+    "constraint": ("uptune_tpu.api.constraint", "constraint"),
+    "register": ("uptune_tpu.api.constraint", "register"),
+    "vars": ("uptune_tpu.api.constraint", "vars"),
+}
+
+
+def __getattr__(name):
+    """Lazy public API, the equivalent of the reference's custom lazy module
+    (`/root/reference/python/uptune/__init__.py:71-143`) without replacing
+    the module object."""
+    try:
+        modname, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(f"module 'uptune_tpu' has no attribute {name!r}")
+    import importlib
+    try:
+        return getattr(importlib.import_module(modname), attr)
+    except ModuleNotFoundError as e:
+        raise AttributeError(
+            f"uptune_tpu.{name} is declared but its module {modname} is not "
+            f"available yet: {e}") from e
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -1,0 +1,2 @@
+"""Batched operator kernels over the flat space encoding."""
+from . import numeric, perm  # noqa: F401

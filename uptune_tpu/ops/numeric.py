@@ -1,0 +1,123 @@
+"""Batched unit-space scalar operators.
+
+TPU-native reimplementation of the reference's primitive-parameter operator
+algebra (`/root/reference/python/uptune/opentuner/search/manipulator.py:
+446-737`).  All scalar lanes hold unit values in [0, 1] (the scale the
+reference searches primitives on), so every operator is a pure elementwise
+function over `[B, D]` float32 arrays — exactly what the MXU/VPU want.
+
+"Complex" lanes (bool / switch / enum — non-cartesian parameters in the
+reference, manipulator.py:841-1046) are handled by masks:
+
+* linear-combination (DE's engine, `op4_set_linear` manipulator.py:523-542 /
+  :866-917) degenerates to copy-a-then-randomize-if-b-differs-from-c;
+* normal mutation degenerates to a uniform redraw (the reference picks a
+  random manipulator — randomize/flip — for complex params,
+  evolutionarytechniques.py:104-115).
+
+Equality of complex lanes is decided on *decoded codes*, not raw unit
+values, so two unit values that round to the same enum option count as
+equal (matching `same_value`, manipulator.py:851-853).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reflect_unit(v: jax.Array) -> jax.Array:
+    """Reflect out-of-range values back into [0, 1] the way
+    op1_normal_mutation does (manipulator.py:505-521): negative values flip
+    sign; values > 1 map to 1 - (v mod 1)."""
+    v = jnp.abs(v)
+    return jnp.where(v > 1.0, 1.0 - jnp.mod(v, 1.0), v)
+
+
+def randomize(key: jax.Array, u: jax.Array,
+              mask: Optional[jax.Array] = None) -> jax.Array:
+    """Uniform redraw of (masked) lanes — op1_randomize
+    (manipulator.py:595-605) batched.  `mask` broadcasts against u."""
+    r = jax.random.uniform(key, u.shape, dtype=u.dtype)
+    if mask is None:
+        return r
+    return jnp.where(mask, r, u)
+
+
+def normal_mutation(key: jax.Array, u: jax.Array, sigma: float,
+                    complex_mask: jax.Array,
+                    mask: Optional[jax.Array] = None) -> jax.Array:
+    """op1_normal_mutation (manipulator.py:505-521) on primitive lanes,
+    uniform redraw on complex lanes; `mask` selects which lanes mutate."""
+    kn, kr = jax.random.split(key)
+    noisy = reflect_unit(u + sigma * jax.random.normal(kn, u.shape, u.dtype))
+    redraw = jax.random.uniform(kr, u.shape, dtype=u.dtype)
+    out = jnp.where(complex_mask, redraw, noisy)
+    if mask is None:
+        return out
+    return jnp.where(mask, out, u)
+
+
+def set_linear(key: jax.Array,
+               ua: jax.Array, ub: jax.Array, uc: jax.Array,
+               a: jax.Array, b: jax.Array, c: jax.Array,
+               complex_mask: jax.Array,
+               codes_equal_bc: jax.Array,
+               mask: Optional[jax.Array] = None,
+               base: Optional[jax.Array] = None) -> jax.Array:
+    """a*ua + b*ub + c*uc clipped to [0, 1] on primitive lanes
+    (op4_set_linear, manipulator.py:523-542); on complex lanes copy ua and
+    redraw only where ub's and uc's decoded codes differ (add_difference,
+    manipulator.py:905-917).
+
+    `mask` selects which lanes the operator applies to (DE's per-parameter
+    crossover mask); unmasked lanes keep `base` (default ua).
+    """
+    if base is None:
+        base = ua
+    lin = jnp.clip(a * ua + b * ub + c * uc, 0.0, 1.0)
+    redraw = jax.random.uniform(key, ua.shape, dtype=ua.dtype)
+    cplx = jnp.where(codes_equal_bc, ua, redraw)
+    out = jnp.where(complex_mask, cplx, lin)
+    if mask is None:
+        return out
+    return jnp.where(mask, out, base)
+
+
+def scale(u: jax.Array, k: float) -> jax.Array:
+    """op1_scale (manipulator.py:607-617) in unit space."""
+    return jnp.clip(u * k, 0.0, 1.0)
+
+
+def swarm(key: jax.Array, u: jax.Array, u_local: jax.Array,
+          u_global: jax.Array, velocity: jax.Array,
+          complex_mask: jax.Array, bool_mask: jax.Array,
+          c: float = 1.0, c1: float = 0.5, c2: float = 0.5):
+    """One PSO position/velocity update per lane, the batched op3_swarm
+    (manipulator.py:660-700 int / :725-745 float / :965-997 bool /
+    :409-423 generic complex).
+
+    Primitive lanes follow the float form (position += velocity, clip) —
+    on the unit scale the integer variant's sigmoid squashing reduces to
+    the same move.  BOOL lanes use the reference's sigmoid-as-coin form.
+    Other complex lanes (SWITCH/ENUM) use the generic ComplexParameter
+    fallback: stochastically keep the current value or copy the local/
+    global best, weighted by (c, c1, c2) — every option stays reachable.
+
+    Returns (new_u, new_velocity).
+    """
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    r1 = jax.random.uniform(k1, u.shape, u.dtype)
+    r2 = jax.random.uniform(k2, u.shape, u.dtype)
+    v = velocity * c + (u_local - u) * c1 * r1 + (u_global - u) * c2 * r2
+    prim = jnp.clip(u + v, 0.0, 1.0)
+    # bool lanes: sigmoid(v) vs uniform coin decides 1/0
+    coin = jax.random.uniform(k3, u.shape, u.dtype)
+    boolean = (jax.nn.sigmoid(v) - coin > 0).astype(u.dtype)
+    # other complex lanes: stochastic mix of (current, local, global)
+    total = c + c1 + c2
+    pick = jax.random.uniform(k4, u.shape, u.dtype) * total
+    mixed = jnp.where(pick < c, u, jnp.where(pick < c + c1, u_local, u_global))
+    cplx = jnp.where(bool_mask, boolean, mixed)
+    return jnp.where(complex_mask, cplx, prim), v

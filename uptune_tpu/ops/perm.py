@@ -1,0 +1,237 @@
+"""Batched permutation operators as fixed-shape gather/scatter kernels.
+
+TPU-native reimplementation of the reference's PermutationParameter /
+ScheduleParameter operator set (`/root/reference/python/uptune/opentuner/
+search/manipulator.py:1048-1445`): random shuffle, adjacent-bubble mutation,
+segment inversion, and the PX / PMX / CX / OX1 / OX3 crossovers, plus the
+dependency-respecting topological normalisation.
+
+Every op works on a single permutation `[n] int32` (a row of item indices)
+with a PRNG key, and is exposed batched via `jax.vmap` wrappers with the
+`*_batch` suffix.  Cut *positions* are traced (data-dependent), but segment
+*lengths* are static Python ints — the ops compile once per (n, d) pair and
+contain no data-dependent shapes, as required for XLA.
+
+Where the reference's list-based code is sequential (PMX repair chains, CX
+cycle walks), we use bounded `fori_loop`s: PMX's mapping chains have length
+<= d, CX's cycle has length <= n.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _inv(p: jax.Array) -> jax.Array:
+    """Inverse permutation: inv[item] = position of item in p."""
+    n = p.shape[-1]
+    return jnp.zeros(n, p.dtype).at[p].set(jnp.arange(n, dtype=p.dtype))
+
+
+def shuffle(key: jax.Array, p: jax.Array) -> jax.Array:
+    """Uniform reshuffle (op1_randomize, manipulator.py:1058-1065)."""
+    return jax.random.permutation(key, p)
+
+
+def small_random_change(key: jax.Array, p: jax.Array, prob: float = 0.25) -> jax.Array:
+    """Left-to-right adjacent-swap bubble pass (op1_small_random_change,
+    manipulator.py:1067-1080): element i-1 swaps with i with probability
+    `prob`, sequentially, so a value can bubble several positions right."""
+    n = p.shape[0]
+    do_swap = jax.random.uniform(key, (n,)) < prob  # index 0 unused
+
+    def body(i, arr):
+        a, b = arr[i - 1], arr[i]
+        sw = do_swap[i]
+        arr = arr.at[i - 1].set(jnp.where(sw, b, a))
+        arr = arr.at[i].set(jnp.where(sw, a, b))
+        return arr
+
+    return lax.fori_loop(1, n, body, p)
+
+
+def random_swap(key: jax.Array, p: jax.Array) -> jax.Array:
+    """Swap two random positions (op2_random_swap, manipulator.py:1143-1159)."""
+    n = p.shape[0]
+    kr, ks = jax.random.split(key)
+    r = jax.random.randint(kr, (), 0, n)
+    s = jax.random.randint(ks, (), 0, n)
+    pr, ps = p[r], p[s]
+    return p.at[r].set(ps).at[s].set(pr)
+
+
+def random_invert(key: jax.Array, p: jax.Array, d: int) -> jax.Array:
+    """Reverse a random length-d window (op2_random_invert,
+    manipulator.py:1161-1177).  d is static."""
+    n = p.shape[0]
+    d = max(1, min(int(d), n))
+    r = jax.random.randint(key, (), 0, n - d + 1)
+    i = jnp.arange(n)
+    in_win = (i >= r) & (i < r + d)
+    src = jnp.where(in_win, 2 * r + d - 1 - i, i)
+    return p[src]
+
+
+def cross_px(key: jax.Array, p1: jax.Array, p2: jax.Array, d: int = 0) -> jax.Array:
+    """Partition crossover (op3_cross_PX, manipulator.py:1336-1352): pick a
+    random cut c in [2, n] and reorder p1's first c elements by their order
+    in p2; the tail keeps p1's order."""
+    n = p1.shape[0]
+    c = jax.random.randint(key, (), 2, n + 1)
+    pos2 = _inv(p2)
+    i = jnp.arange(n)
+    # stable sort key: head elements rank by position-in-p2, tail keeps order
+    sortkey = jnp.where(i < c, pos2[p1], n + i)
+    order = jnp.argsort(sortkey, stable=True)
+    return p1[order]
+
+
+def cross_pmx(key: jax.Array, p1: jax.Array, p2: jax.Array, d: int) -> jax.Array:
+    """Partially-mapped crossover, Goldberg & Lingle 1985 (op3_cross_PMX,
+    manipulator.py:1199-1263): copy p2's window [r, r+d) into p1; values
+    displaced outside the window follow the window's p2->p1 mapping chain
+    until they land on a value not present in the copied window."""
+    n = p1.shape[0]
+    d = max(1, min(int(d), n))
+    r = jax.random.randint(key, (), 0, n - d + 1)
+    pos2 = _inv(p2)
+    i = jnp.arange(n)
+    in_win = (i >= r) & (i < r + d)
+
+    def in_seg(v):  # value v is inside the copied p2-window?
+        return (pos2[v] >= r) & (pos2[v] < r + d)
+
+    # outside the window start from p1's value; chase the mapping <= d times
+    def chase(_, v):
+        return jnp.where(in_seg(v), p1[pos2[v]], v)
+
+    fixed = lax.fori_loop(0, d, chase, p1)
+    return jnp.where(in_win, p2, fixed)
+
+
+def cross_cx(key: jax.Array, p1: jax.Array, p2: jax.Array, d: int = 0) -> jax.Array:
+    """Cyclic crossover (op3_cross_CX, manipulator.py:1265-1302): walk the
+    cycle i -> pos2[p1[i]] from a random start, then take p2's values on the
+    cycle and p1's elsewhere."""
+    n = p1.shape[0]
+    s = jax.random.randint(key, (), 0, n)
+    pos2 = _inv(p2)
+
+    def body(_, carry):
+        i, mask, done = carry
+        mask = mask.at[i].set(True)
+        nxt = pos2[p1[i]]
+        done = done | (nxt == s)
+        i = jnp.where(done, i, nxt)
+        return i, mask, done
+
+    _, mask, _ = lax.fori_loop(
+        0, n, body, (s, jnp.zeros(n, bool), jnp.asarray(False)))
+    return jnp.where(mask, p2, p1)
+
+
+def _ox(key: jax.Array, p1: jax.Array, p2: jax.Array, d: int,
+        same_cut: bool) -> jax.Array:
+    """Shared core of OX1/OX3 (manipulator.py:1304-1356): insert p2's window
+    [r2, r2+d) at position r1 of the sequence formed by p1's remaining
+    elements in p1-order."""
+    n = p1.shape[0]
+    d = max(1, min(int(d), n))
+    k1, k2 = jax.random.split(key)
+    r2 = jax.random.randint(k2, (), 0, n - d + 1)
+    r1 = r2 if same_cut else jax.random.randint(k1, (), 0, n - d + 1)
+    pos2 = _inv(p2)
+    seg_of = (pos2 >= r2) & (pos2 < r2 + d)        # by item id
+    keep = ~seg_of[p1]                              # p1 positions kept
+    rem_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    out_keep = jnp.where(rem_rank < r1, rem_rank, rem_rank + d)
+    out_idx = jnp.where(keep, out_keep, r1 + (pos2[p1] - r2))
+    return jnp.zeros_like(p1).at[out_idx].set(p1)
+
+
+def cross_ox1(key: jax.Array, p1: jax.Array, p2: jax.Array, d: int) -> jax.Array:
+    """Ordered crossover, Davis 1985 (op3_cross_OX1): one shared cut."""
+    return _ox(key, p1, p2, d, same_cut=True)
+
+
+def cross_ox3(key: jax.Array, p1: jax.Array, p2: jax.Array, d: int) -> jax.Array:
+    """Ordered crossover v3, Deep 2010 (op3_cross_OX3): independent cuts."""
+    return _ox(key, p1, p2, d, same_cut=False)
+
+
+CROSSOVERS = {
+    "PX": cross_px,
+    "PMX": cross_pmx,
+    "CX": cross_cx,
+    "OX1": cross_ox1,
+    "OX3": cross_ox3,
+}
+
+
+def toposort_one(p: jax.Array, dep: jax.Array) -> jax.Array:
+    """Stable topological normalisation of one permutation.
+
+    dep[i, j] True means item i requires item j earlier.  Emits, n times, the
+    not-yet-emitted item with all prerequisites emitted that currently sits
+    earliest in p.  This is the *intent* of ScheduleParameter.normalize
+    (manipulator.py:1425-1445); the reference's queue implementation reverses
+    its output (and its `is_topologically_sorted` guard uses `union` where
+    `difference` was meant, manipulator.py:1400-1406) — we implement the
+    correct stable ordering rather than reproducing those bugs.
+    """
+    n = p.shape[0]
+    rank = _inv(p)  # rank[item] = current position
+
+    def body(i, carry):
+        emitted, out = carry
+        ready = (~emitted) & jnp.all((~dep) | emitted[None, :], axis=1)
+        score = jnp.where(ready, rank, n + 1)
+        item = jnp.argmin(score).astype(p.dtype)
+        emitted = emitted.at[item].set(True)
+        out = out.at[i].set(item)
+        return emitted, out
+
+    _, out = lax.fori_loop(
+        0, n, body, (jnp.zeros(n, bool), jnp.zeros(n, p.dtype)))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=())
+def toposort_batch(pm: jax.Array, dep: jax.Array) -> jax.Array:
+    """[B, n] batched topological normalisation."""
+    return jax.vmap(toposort_one, in_axes=(0, None))(pm, dep)
+
+
+# -- batched wrappers -------------------------------------------------------
+
+def _vmap1(fn):
+    """Batch a (key, p, ...) op over [B, n] with per-row keys."""
+    @functools.wraps(fn)
+    def wrapped(key, pm, *args, **kwargs):
+        keys = jax.random.split(key, pm.shape[0])
+        return jax.vmap(lambda k, p: fn(k, p, *args, **kwargs))(keys, pm)
+    return wrapped
+
+
+def _vmap2(fn):
+    @functools.wraps(fn)
+    def wrapped(key, pm1, pm2, *args, **kwargs):
+        keys = jax.random.split(key, pm1.shape[0])
+        return jax.vmap(lambda k, a, b: fn(k, a, b, *args, **kwargs))(
+            keys, pm1, pm2)
+    return wrapped
+
+
+shuffle_batch = _vmap1(shuffle)
+small_random_change_batch = _vmap1(small_random_change)
+random_swap_batch = _vmap1(random_swap)
+random_invert_batch = _vmap1(random_invert)
+cross_px_batch = _vmap2(cross_px)
+cross_pmx_batch = _vmap2(cross_pmx)
+cross_cx_batch = _vmap2(cross_cx)
+cross_ox1_batch = _vmap2(cross_ox1)
+cross_ox3_batch = _vmap2(cross_ox3)

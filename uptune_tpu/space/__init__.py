@@ -1,0 +1,7 @@
+"""Search-space layer: parameter specs + flat device encoding."""
+from .params import (  # noqa: F401
+    FLOAT, INT, LOG_FLOAT, LOG_INT, POW2, BOOL, SWITCH, ENUM,
+    ParamSpec, FloatParam, IntParam, LogFloatParam, LogIntParam, Pow2Param,
+    BoolParam, SwitchParam, EnumParam, PermParam, ScheduleParam, infer_param,
+)
+from .spec import CandBatch, Space, concat_cands  # noqa: F401

@@ -1,0 +1,352 @@
+"""The flat, device-resident search-space encoding.
+
+This replaces the reference's `ConfigurationManipulator` + dict-of-values
+configurations (`/root/reference/python/uptune/opentuner/search/
+manipulator.py:129-272`) with a fixed-shape array encoding so that whole
+*batches* of candidate configurations live on TPU:
+
+* every scalar parameter is one float32 lane holding a **unit value** in
+  [0, 1] — exactly the scale the reference searches primitives on
+  (`get_unit_value`/`set_unit_value`, manipulator.py:473-503);
+* every permutation parameter is one int32 block of item indices.
+
+A batch of B candidates over a space with D scalar lanes and perm blocks of
+sizes (s0, s1, ...) is a `CandBatch(u=[B, D] f32, perms=([B, s0] i32, ...))`
+pytree.  All mutation / crossover operators (uptune_tpu.ops) and all search
+techniques act on this representation; decode back to user values happens
+only at the evaluation boundary.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import params as P
+
+
+class CandBatch(NamedTuple):
+    """A batch of candidate configurations in flat device encoding."""
+    u: jax.Array                    # [B, D] float32 unit values
+    perms: Tuple[jax.Array, ...]    # each [B, size_k] int32 item indices
+
+    @property
+    def batch(self) -> int:
+        return self.u.shape[0]
+
+    def __getitem__(self, idx) -> "CandBatch":
+        # NamedTuple would otherwise give positional indexing; we want
+        # batch-axis selection so `cands[mask]` / `cands[topk]` just work.
+        if isinstance(idx, int) and not isinstance(idx, bool):
+            raise TypeError("use slices/arrays; scalar indexing drops the batch dim")
+        return CandBatch(self.u[idx], tuple(p[idx] for p in self.perms))
+
+    def concat(self, other: "CandBatch") -> "CandBatch":
+        return CandBatch(
+            jnp.concatenate([self.u, other.u], axis=0),
+            tuple(jnp.concatenate([a, b], axis=0)
+                  for a, b in zip(self.perms, other.perms)))
+
+
+def concat_cands(cands: Sequence[CandBatch]) -> CandBatch:
+    return CandBatch(
+        jnp.concatenate([c.u for c in cands], axis=0),
+        tuple(jnp.concatenate(ps, axis=0)
+              for ps in zip(*[c.perms for c in cands])))
+
+
+class Space:
+    """Static (host-side, hashable-by-id) description of a search space plus
+    the numpy/JAX constant tables used by the device codecs.
+
+    The table layout mirrors what the reference spreads across parameter
+    objects: per-lane kind, search-scale bounds (slo/shi), decoded-value
+    bounds (vlo/vhi), and the complex-parameter mask that switches
+    linear-combination operators to randomize-if-differ semantics
+    (manipulator.py:866-917).
+    """
+
+    def __init__(self, specs: Sequence[P.ParamSpec]):
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate parameter names: {names}")
+        self.specs: Tuple[P.ParamSpec, ...] = tuple(specs)
+        self.scalars: Tuple[P._ScalarSpec, ...] = tuple(
+            s for s in specs if not s.is_permutation)
+        self.perm_specs: Tuple[P.PermParam, ...] = tuple(
+            s for s in specs if s.is_permutation)
+        self.name_to_spec = {s.name: s for s in specs}
+
+        D = len(self.scalars)
+        kind = np.zeros(D, np.int32)
+        slo = np.zeros(D, np.float32)
+        shi = np.zeros(D, np.float32)
+        vlo = np.zeros(D, np.float32)
+        vhi = np.zeros(D, np.float32)
+        for i, s in enumerate(self.scalars):
+            kind[i] = s.kind
+            a, b = s.scaled_range()
+            slo[i], shi[i] = a, b
+            if isinstance(s, (P.FloatParam, P.IntParam, P.LogFloatParam,
+                              P.LogIntParam)):
+                vlo[i], vhi[i] = float(s.lo), float(s.hi)
+            elif isinstance(s, P.Pow2Param):
+                vlo[i], vhi[i] = s.exp_lo, s.exp_hi  # exponent bounds
+            elif isinstance(s, P.BoolParam):
+                vlo[i], vhi[i] = 0, 1
+            elif isinstance(s, P.SwitchParam):
+                vlo[i], vhi[i] = 0, s.n - 1
+            elif isinstance(s, P.EnumParam):
+                vlo[i], vhi[i] = 0, len(s.options) - 1
+            else:  # pragma: no cover
+                raise TypeError(s)
+        self.kind = jnp.asarray(kind)
+        self.slo = jnp.asarray(slo)
+        self.shi = jnp.asarray(shi)
+        self.vlo = jnp.asarray(vlo)
+        self.vhi = jnp.asarray(vhi)
+        # lanes with integer-valued decodes (hash on the integer)
+        self._int_mask_np = np.isin(
+            kind, [P.INT, P.LOG_INT, P.POW2, P.BOOL, P.SWITCH, P.ENUM])
+        self.int_mask = jnp.asarray(self._int_mask_np)
+        # lanes using complex-parameter (randomize-if-differ) semantics
+        self.complex_mask = jnp.asarray(kind >= P.COMPLEX_KIND_START)
+        self.n_scalar = D
+        self.perm_sizes: Tuple[int, ...] = tuple(p.size for p in self.perm_specs)
+        # dependency matrices for ScheduleParams ([] entry = no constraint)
+        self.perm_dep_mats: Tuple[Any, ...] = tuple(
+            jnp.asarray(np.array(p.dep_matrix(), dtype=bool))
+            if isinstance(p, P.ScheduleParam) else None
+            for p in self.perm_specs)
+        # universal-hash multipliers (fixed seed => stable across runs/resume)
+        rng = np.random.RandomState(0x5EED)
+        n_lanes = D + sum(self.perm_sizes)
+        self._hash_mults = jnp.asarray(
+            (rng.randint(0, 2**31, size=(2, max(1, n_lanes)), dtype=np.int64)
+             * 2 + 1).astype(np.uint32))
+
+    # -- python niceties ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def __repr__(self) -> str:
+        return (f"Space(D={self.n_scalar} scalar lanes, "
+                f"perms={list(self.perm_sizes)}, params={len(self.specs)})")
+
+    def search_space_size(self) -> float:
+        """Product of per-parameter sizes (manipulator.py:245-247)."""
+        out = 1.0
+        for s in self.specs:
+            out *= s.search_space_size()
+        return out
+
+    # -- device codecs -----------------------------------------------------
+    def decode_scalars(self, u: jax.Array) -> jax.Array:
+        """Unit lanes [..., D] -> decoded values [..., D] float32.
+
+        Reproduces `set_unit_value` semantics per kind (manipulator.py:
+        489-503): scale into [slo, shi], round for integer types, clamp to
+        the legal range.  BOOL/SWITCH/ENUM decode to their integer code;
+        ENUM option objects are applied host-side in `to_configs`.
+        POW2 decodes to the power-of-two *value*.
+        """
+        s = u * (self.shi - self.slo) + self.slo
+        kind = self.kind
+        val = s  # FLOAT
+        # INT: round+clamp in value space
+        val = jnp.where(kind == P.INT,
+                        jnp.clip(jnp.round(s), self.vlo, self.vhi), val)
+        # LOG_FLOAT: 2**s - 1 + lo   (vlo == lo), computed as
+        # expm1(s*ln2) + lo to avoid the catastrophic cancellation of
+        # exp2(s) - 1 near s == 0 in f32
+        ln2 = 0.6931471805599453
+        log_val = jnp.expm1(s * ln2) + self.vlo
+        val = jnp.where(kind == P.LOG_FLOAT, log_val, val)
+        # LOG_INT: round(2**s - 1 + lo) clamped
+        val = jnp.where(kind == P.LOG_INT,
+                        jnp.clip(jnp.round(log_val), self.vlo, self.vhi), val)
+        # POW2: 2**round(exponent)
+        val = jnp.where(kind == P.POW2,
+                        jnp.exp2(jnp.clip(jnp.round(s), self.vlo, self.vhi)),
+                        val)
+        # BOOL / SWITCH / ENUM: integer code
+        code = jnp.clip(jnp.round(s), self.vlo, self.vhi)
+        val = jnp.where(kind >= P.BOOL, code, val)
+        return val.astype(jnp.float32)
+
+    def encode_scalars(self, vals: jax.Array) -> jax.Array:
+        """Decoded values [..., D] -> unit lanes, inverse of decode_scalars
+        (mirrors `get_unit_value`, manipulator.py:473-488)."""
+        kind = self.kind
+        s = vals  # FLOAT / INT-style value space
+        # log kinds: s = log2(v + 1 - lo) = log1p(v - lo) / ln2, the
+        # well-conditioned companion of the expm1 decode above
+        inv_ln2 = 1.4426950408889634
+        s = jnp.where((kind == P.LOG_FLOAT) | (kind == P.LOG_INT),
+                      jnp.log1p(jnp.maximum(vals - self.vlo, -0.999)) * inv_ln2,
+                      s)
+        s = jnp.where(kind == P.POW2,
+                      jnp.log2(jnp.maximum(vals, 1.0)), s)
+        rng = jnp.maximum(self.shi - self.slo, 1e-30)
+        return jnp.clip((s - self.slo) / rng, 0.0, 1.0).astype(jnp.float32)
+
+    def random(self, key: jax.Array, n: int) -> CandBatch:
+        """Uniform random batch (the batched `manipulator.random()`)."""
+        ku, *kp = jax.random.split(key, 1 + max(1, len(self.perm_sizes)))
+        u = jax.random.uniform(ku, (n, self.n_scalar), dtype=jnp.float32)
+        perms = []
+        for size, k, dep in zip(self.perm_sizes, kp, self.perm_dep_mats):
+            pm = jax.vmap(lambda kk: jax.random.permutation(kk, size))(
+                jax.random.split(k, n)).astype(jnp.int32)
+            perms.append(pm)
+        cands = CandBatch(u, tuple(perms))
+        return self.normalize(cands)
+
+    def seed_default(self, n: int) -> CandBatch:
+        """Batch of n copies of the seed (default) configuration: scalar
+        seed = lo (NumericParameter.seed_value, manipulator.py:581-583),
+        perm seed = identity ordering (manipulator.py:1084-1085)."""
+        u0 = self.encode_scalars(
+            jnp.where(self.kind == P.POW2, jnp.exp2(self.vlo), self.vlo))
+        u = jnp.tile(u0[None, :], (n, 1))
+        perms = tuple(
+            jnp.tile(jnp.arange(size, dtype=jnp.int32)[None, :], (n, 1))
+            for size in self.perm_sizes)
+        return CandBatch(u, perms)
+
+    def normalize(self, cands: CandBatch) -> CandBatch:
+        """Topologically normalise ScheduleParam blocks (manipulator.py:
+        1425-1445); other blocks pass through."""
+        from ..ops import perm as perm_ops  # local import to avoid cycle
+        perms = tuple(
+            perm_ops.toposort_batch(pm, dep) if dep is not None else pm
+            for pm, dep in zip(cands.perms, self.perm_dep_mats))
+        return CandBatch(cands.u, perms)
+
+    def canonical_lanes(self, cands: CandBatch) -> jax.Array:
+        """[B, n_lanes] int32 canonical representation used for hashing:
+        integer lanes use their decoded integer, float lanes bitcast the
+        decoded f32, perm blocks append their indices.  Equal configs map to
+        equal lanes (the analogue of `hash_config`, manipulator.py:233-243)."""
+        vals = self.decode_scalars(cands.u)
+        as_int = jnp.round(vals).astype(jnp.int32)
+        # Float lanes hash on a 2^16 unit-space grid rather than the decoded
+        # value: decode of log-scaled params (2^s - 1 + lo) cancels
+        # catastrophically in f32 near the low end, so value-space hashing
+        # is not stable under an encode/decode round-trip (archive replay
+        # via from_configs).  The unit transform is well-conditioned in both
+        # directions, so quantizing u is round-trip robust; it also defines
+        # dedup granularity: float configs closer than 2^-16 of the search
+        # range count as the same configuration.
+        as_grid = jnp.round(cands.u * 65536.0).astype(jnp.int32)
+        lanes = jnp.where(self.int_mask, as_int, as_grid)
+        parts = [lanes] + [p.astype(jnp.int32) for p in cands.perms]
+        return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else lanes
+
+    def hash_batch(self, cands: CandBatch) -> jax.Array:
+        """[B] uint64-equivalent hash as a [B, 2] uint32 pair (multiply-shift
+        universal hashing; device-side replacement for the reference's
+        sha256-of-repr config hashing, manipulator.py:233-243)."""
+        lanes = self.canonical_lanes(cands).astype(jnp.uint32)
+        h = (lanes[..., None, :] * self._hash_mults).sum(axis=-1)
+        return h.astype(jnp.uint32)  # [B, 2]
+
+    # -- host codecs (evaluation boundary) ---------------------------------
+    # These run in float64 numpy: XLA's f32 transcendentals are only ~3e-5
+    # accurate, so a device-side decode->encode round-trip of log-scaled
+    # params would drift across hash-grid boundaries.  Host decode and host
+    # encode are exact inverses to f64 precision, which makes archive
+    # replay (from_configs of to_configs output) hash-stable; the device
+    # decode (decode_scalars) agrees with the host decode to f32
+    # transcendental accuracy, which only matters for surrogate features.
+    def decode_scalars_np(self, u: np.ndarray) -> np.ndarray:
+        kind = np.asarray(self.kind)
+        slo = np.asarray(self.slo, np.float64)
+        shi = np.asarray(self.shi, np.float64)
+        vlo = np.asarray(self.vlo, np.float64)
+        vhi = np.asarray(self.vhi, np.float64)
+        s = np.asarray(u, np.float64) * (shi - slo) + slo
+        val = s.copy()
+        m = kind == P.INT
+        val[..., m] = np.clip(np.round(s[..., m]), vlo[m], vhi[m])
+        m = kind == P.LOG_FLOAT
+        val[..., m] = np.expm1(s[..., m] * np.log(2.0)) + vlo[m]
+        m = kind == P.LOG_INT
+        val[..., m] = np.clip(np.round(np.expm1(s[..., m] * np.log(2.0)) + vlo[m]),
+                              vlo[m], vhi[m])
+        m = kind == P.POW2
+        val[..., m] = np.exp2(np.clip(np.round(s[..., m]), vlo[m], vhi[m]))
+        m = kind >= P.BOOL
+        val[..., m] = np.clip(np.round(s[..., m]), vlo[m], vhi[m])
+        return val
+
+    def encode_scalars_np(self, vals: np.ndarray) -> np.ndarray:
+        kind = np.asarray(self.kind)
+        slo = np.asarray(self.slo, np.float64)
+        shi = np.asarray(self.shi, np.float64)
+        vlo = np.asarray(self.vlo, np.float64)
+        s = np.asarray(vals, np.float64).copy()
+        m = (kind == P.LOG_FLOAT) | (kind == P.LOG_INT)
+        s[..., m] = np.log1p(np.maximum(s[..., m] - vlo[m], -0.999)) / np.log(2.0)
+        m = kind == P.POW2
+        s[..., m] = np.log2(np.maximum(s[..., m], 1.0))
+        rng = np.maximum(shi - slo, 1e-30)
+        return np.clip((s - slo) / rng, 0.0, 1.0).astype(np.float32)
+
+    def to_configs(self, cands: CandBatch) -> List[Dict[str, Any]]:
+        """Decode a device batch into user-facing config dicts."""
+        vals = self.decode_scalars_np(np.asarray(cands.u))
+        perms = [np.asarray(p) for p in cands.perms]
+        out: List[Dict[str, Any]] = []
+        for b in range(vals.shape[0]):
+            cfg: Dict[str, Any] = {}
+            for i, s in enumerate(self.scalars):
+                v = vals[b, i]
+                if isinstance(s, P.FloatParam) or isinstance(s, P.LogFloatParam):
+                    cfg[s.name] = float(v)
+                elif isinstance(s, P.EnumParam):
+                    cfg[s.name] = s.options[int(round(float(v)))]
+                elif isinstance(s, P.BoolParam):
+                    cfg[s.name] = bool(round(float(v)))
+                else:  # INT / LOG_INT / POW2 / SWITCH
+                    cfg[s.name] = int(round(float(v)))
+            for k, s in enumerate(self.perm_specs):
+                cfg[s.name] = [s.items[int(i)] for i in perms[k][b]]
+            out.append(cfg)
+        return out
+
+    def from_configs(self, cfgs: Sequence[Dict[str, Any]]) -> CandBatch:
+        """Encode user config dicts into a device batch (seed configs).
+
+        Hash-stability contract: from_configs(to_configs(x)) hashes equal to
+        x on every lane except LOG_INT lanes with ranges wider than ~2^15,
+        where XLA's ~3e-5-relative f32 transcendentals can shift the device
+        decode by an integer (observed ~5% of rows at a 2^20 range).  Exact
+        resume therefore replays raw unit vectors from the archive (see
+        driver.history), not configs; this path is for user-provided seeds
+        where an occasional duplicate evaluation is harmless.
+        """
+        B = len(cfgs)
+        vals = np.zeros((B, self.n_scalar), np.float64)
+        for b, cfg in enumerate(cfgs):
+            for i, s in enumerate(self.scalars):
+                v = cfg[s.name]
+                if isinstance(s, P.EnumParam):
+                    vals[b, i] = s.options.index(v)
+                elif isinstance(s, P.BoolParam):
+                    vals[b, i] = float(bool(v))
+                else:
+                    vals[b, i] = float(v)
+            # POW2 lanes hold the value; encode maps to exponent
+        u = jnp.asarray(self.encode_scalars_np(vals))
+        perms = []
+        for k, s in enumerate(self.perm_specs):
+            block = np.zeros((B, s.size), np.int32)
+            for b, cfg in enumerate(cfgs):
+                order = cfg[s.name]
+                block[b] = [s.items.index(it) for it in order]
+            perms.append(jnp.asarray(block))
+        return CandBatch(u, tuple(perms))
