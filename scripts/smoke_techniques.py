@@ -1,0 +1,44 @@
+import sys, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).parent))
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+import cpuenv  # noqa: F401
+import jax
+import jax.numpy as jnp
+
+from uptune_tpu.space import params as P
+from uptune_tpu.space.spec import Space
+from uptune_tpu.techniques import base as tb
+from uptune_tpu.techniques.bandit import MetaTechnique
+
+space = Space([P.FloatParam('x', -5, 5), P.FloatParam('y', -5, 5),
+               P.IntParam('n', 0, 10), P.EnumParam('e', options=('a', 'b', 'c')),
+               P.PermParam('p', items=tuple(range(8)))])
+
+
+def rosen_eval(cands):
+    u = space.decode_scalars(cands.u)
+    x, y = u[:, 0], u[:, 1]
+    return (1 - x) ** 2 + 100 * (y - x * x) ** 2
+
+
+names = tb.all_technique_names()
+print(len(names), 'techniques')
+key = jax.random.PRNGKey(0)
+for nm in names:
+    t = tb.get_technique(nm)
+    if isinstance(t, MetaTechnique):
+        continue
+    if not t.supports(space):
+        print('skip', nm)
+        continue
+    k1, k2, key = jax.random.split(key, 3)
+    st = t.init_state(space, k1)
+    best = tb.Best.empty(space)
+    for i in range(3):
+        kk = jax.random.fold_in(k2, i)
+        st, cands = t.propose(space, st, kk, best)
+        assert cands.u.shape[0] == t.natural_batch(space), (nm, cands.u.shape)
+        qor = rosen_eval(cands)
+        best = best.update(cands, qor)
+        st = t.observe(space, st, cands, qor, best)
+    print('ok', nm, float(best.qor))

@@ -215,7 +215,7 @@ class Space:
         perms = tuple(
             jnp.tile(jnp.arange(size, dtype=jnp.int32)[None, :], (n, 1))
             for size in self.perm_sizes)
-        return CandBatch(u, perms)
+        return self.normalize(CandBatch(u, perms))
 
     def normalize(self, cands: CandBatch) -> CandBatch:
         """Topologically normalise ScheduleParam blocks (manipulator.py:
@@ -349,4 +349,4 @@ class Space:
                 order = cfg[s.name]
                 block[b] = [s.items.index(it) for it in order]
             perms.append(jnp.asarray(block))
-        return CandBatch(u, tuple(perms))
+        return self.normalize(CandBatch(u, tuple(perms)))

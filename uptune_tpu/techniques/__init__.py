@@ -1,0 +1,7 @@
+"""Batched search techniques (the reference's plugin registry, §2.2 of
+SURVEY.md, re-designed as pure JAX state machines)."""
+from .base import (Best, Technique, all_technique_names, get_root,
+                   get_technique, register)
+
+__all__ = ["Best", "Technique", "all_technique_names", "get_root",
+           "get_technique", "register"]

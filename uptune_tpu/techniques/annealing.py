@@ -1,0 +1,126 @@
+"""Batched pseudo simulated annealing.
+
+Reference: `/root/reference/python/uptune/opentuner/search/
+simulatedannealing.py:11-136`.  One annealing chain over a linear cooling
+schedule (temps 30 -> 0 over 100 intervals, looped); each round proposes
+up/down neighbors of the current state (step scaled by
+exp(-(20 + t/100)/(temp+1))), then accepts the `sel`-th best point where
+sel is geometric with success probability exp(-1/temp) — plus a switch to
+the global best when the temperature is effectively zero.
+
+Batched: instead of enumerating two neighbors for every parameter (2·D
+proposals), one step samples `batch` random (parameter, direction) moves —
+the same neighborhood distribution at fixed batch shape.  The acceptance
+rule is applied branchlessly over the sorted batch.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import perm as pops
+from ..space.spec import CandBatch, Space
+from .base import Best, Technique, register
+from .common import mutate_perm_random_op
+
+
+class SAState(NamedTuple):
+    cur: CandBatch         # [1, ...] current chain state
+    cur_qor: jax.Array     # scalar
+    counter: jax.Array     # scalar i32, cooling-schedule position
+    key: jax.Array         # acceptance-rule randomness
+
+
+class PseudoAnnealingSearch(Technique):
+    def __init__(self, batch: int = 32, t_hi: float = 30.0, t_lo: float = 0.0,
+                 interval: int = 100, scaling: float = 50.0,
+                 name: str = "PseudoAnnealingSearch"):
+        super().__init__(name)
+        self.batch = batch
+        self.t_hi = t_hi
+        self.t_lo = t_lo
+        self.interval = interval
+        self.scaling = scaling
+
+    def natural_batch(self, space: Space) -> int:
+        return self.batch
+
+    def _temp(self, counter: jax.Array) -> jax.Array:
+        """Linear 30 -> 0 schedule over `interval` steps, looping
+        (simulatedannealing.py:22-33, 115-117)."""
+        c = jnp.mod(counter, self.interval).astype(jnp.float32)
+        return self.t_hi + (self.t_lo - self.t_hi) * c / self.interval
+
+    def init_state(self, space: Space, key: jax.Array) -> SAState:
+        kc, ka = jax.random.split(key)
+        cur = space.random(kc, 1)
+        return SAState(cur, jnp.asarray(jnp.inf), jnp.asarray(0, jnp.int32),
+                       ka)
+
+    def propose(self, space: Space, state: SAState, key: jax.Array,
+                best: Best) -> Tuple[SAState, CandBatch]:
+        n = self.batch
+        kd, kdir, kstep, *kperm = jax.random.split(
+            key, 3 + len(space.perm_sizes))
+        temp = self._temp(state.counter)
+        step = jnp.exp(-(20.0 + state.counter.astype(jnp.float32) / 100.0)
+                       / (temp + 1.0))
+
+        # each row perturbs one random parameter up or down by step*U(0,1)
+        P = space.n_scalar + len(space.perm_sizes)
+        which = jax.random.randint(kd, (n,), 0, P)
+        direction = jnp.where(jax.random.uniform(kdir, (n, 1)) < 0.5, -1.0, 1.0)
+        mag = step * jax.random.uniform(kstep, (n, 1))
+        base_u = jnp.tile(state.cur.u, (n, 1))
+        lane_sel = which[:, None] == jnp.arange(space.n_scalar)[None, :]
+        u = jnp.clip(base_u + lane_sel * direction * mag, 0.0, 1.0)
+        perms = []
+        for k_i, kk in enumerate(kperm):
+            pm = jnp.tile(state.cur.perms[k_i], (n, 1))
+            sel = which == (space.n_scalar + k_i)
+            perms.append(mutate_perm_random_op(kk, pm, sel))
+        return state, space.normalize(CandBatch(u, tuple(perms)))
+
+    def observe(self, space: Space, state: SAState, cands: CandBatch,
+                qor: jax.Array, best: Best) -> SAState:
+        temp = self._temp(state.counter)
+        # sort the candidate pool (current state participates,
+        # simulatedannealing.py:57-59)
+        all_qor = jnp.concatenate([qor, state.cur_qor[None]])
+        order = jnp.argsort(all_qor)
+        # sel ~ geometric(p) with p = exp(-1/temp): number of coin successes
+        # (simulatedannealing.py:105-109), computed in closed form
+        p = jnp.exp(-1.0 / jnp.maximum(temp, 1e-6))
+        ukey, knext = jax.random.split(state.key)
+        usel = jax.random.uniform(ukey, ())
+        sel = jnp.where(
+            p > 1e-9,
+            jnp.floor(jnp.log(jnp.maximum(usel, 1e-30)) /
+                      jnp.log(jnp.maximum(p, 1e-30))).astype(jnp.int32),
+            0)
+        sel = jnp.mod(sel, all_qor.shape[0])
+        pick = order[sel]
+        B = qor.shape[0]
+
+        def row(x_cands, x_cur):
+            stacked = jnp.concatenate([x_cands, x_cur[None]], axis=0)
+            return stacked[pick]
+
+        new_u = row(cands.u, state.cur.u[0])
+        new_perms = tuple(row(c, p[0])
+                          for c, p in zip(cands.perms, state.cur.perms))
+        new_qor = all_qor[pick]
+        # switch to global best when frozen (simulatedannealing.py:111-113)
+        frozen = (p < 1e-4) & (best.qor < new_qor)
+        new_u = jnp.where(frozen, best.u, new_u)
+        new_perms = tuple(jnp.where(frozen, b, p)
+                          for b, p in zip(best.perms, new_perms))
+        new_qor = jnp.where(frozen, best.qor, new_qor)
+        return SAState(
+            CandBatch(new_u[None, :], tuple(p[None, :] for p in new_perms)),
+            new_qor, state.counter + 1, knext)
+
+
+register(PseudoAnnealingSearch())

@@ -1,0 +1,196 @@
+"""Meta-techniques: AUC multi-armed bandit and round-robin portfolios.
+
+Reference: `/root/reference/python/uptune/opentuner/search/
+bandittechniques.py` and `metatechniques.py`.
+
+The bandit's decision ("which technique proposes next") is inherently host
+control flow — it selects which jitted proposal program the driver launches
+for the step.  The arms' state is tiny (a window-500 event deque), so it
+stays host-side with the reference's exact semantics:
+
+* exploitation = sliding-window AUC credit of was-new-best events
+  (`AUCBanditQueue.exploitation_term_fast`, bandittechniques.py:116-146,
+  O(1) incremental update with auc_sum/auc_decay);
+* exploration = sqrt(2*log2(|history|) / use_count)
+  (bandittechniques.py:41-48);
+* score = exploit + C * explore, C=0.05, window=500 (:21).
+
+Batched credit assignment: the reference credits one proposal at a time; a
+batched step pushes ONE event per step — value = "this step's batch
+produced a new global best".  This preserves the AUC ordering semantics
+while each arm pull buys a whole candidate batch.
+
+For the fully fused on-device tuning step (bench path), see
+`uptune_tpu.engine.fused`: there every arm proposes each step and the
+bandit weights determine the per-arm candidate counts.
+"""
+from __future__ import annotations
+
+import math
+import random as _pyrandom
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+from .base import Technique, register
+
+
+class AUCBanditQueue:
+    """Host-side exact port of the reference's AUC bandit credit queue."""
+
+    def __init__(self, keys: Sequence[str], C: float = 0.05,
+                 window: int = 500, seed: int = 0):
+        self.C = C
+        self.window = window
+        self.keys = list(keys)
+        self.history: deque = deque()
+        self.use_counts: Dict[str, int] = {k: 0 for k in keys}
+        self.auc_sum: Dict[str, float] = {k: 0.0 for k in keys}
+        self.auc_decay: Dict[str, float] = {k: 0.0 for k in keys}
+        self.rng = _pyrandom.Random(seed)
+
+    def exploitation_term(self, key: str) -> float:
+        pos = self.use_counts[key]
+        if not pos:
+            return 0.0
+        return self.auc_sum[key] * 2.0 / (pos * (pos + 1.0))
+
+    def exploration_term(self, key: str) -> float:
+        if self.use_counts[key] > 0 and len(self.history) > 1:
+            return math.sqrt(2.0 * math.log2(len(self.history))
+                             / self.use_counts[key])
+        return float("inf")
+
+    def bandit_score(self, key: str) -> float:
+        return self.exploitation_term(key) + self.C * self.exploration_term(key)
+
+    def ordered_keys(self) -> List[str]:
+        """Best-scoring first; ties broken randomly (reference shuffles then
+        stable-sorts ascending and iterates reversed)."""
+        keys = list(self.keys)
+        self.rng.shuffle(keys)
+        keys.sort(key=self.bandit_score, reverse=True)
+        return keys
+
+    def on_result(self, key: str, value: bool) -> None:
+        self.history.append((key, value))
+        self.use_counts[key] += 1
+        if value:
+            self.auc_sum[key] += self.use_counts[key]
+            self.auc_decay[key] += 1
+        if len(self.history) > self.window:
+            k, v = self.history.popleft()
+            self.use_counts[k] -= 1
+            self.auc_sum[k] -= self.auc_decay[k]
+            if v:
+                self.auc_decay[k] -= 1
+
+
+class MetaTechnique(Technique):
+    """A technique made of sub-techniques; the driver unrolls it (jitting
+    each member) and calls select_order()/credit() host-side per step
+    (metatechniques.py:14-76)."""
+
+    def __init__(self, techniques: Sequence[Technique],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        seen = set()
+        uniq = []
+        for t in techniques:
+            nm = t.name
+            while nm in seen:
+                nm += "~"
+            if nm != t.name:
+                import copy
+                t = copy.copy(t)
+                t.name = nm
+            seen.add(nm)
+            uniq.append(t)
+        self.techniques: List[Technique] = uniq
+
+    def select_order(self) -> List[Technique]:
+        raise NotImplementedError
+
+    def credit(self, name: str, was_new_best: bool) -> None:
+        pass
+
+
+class AUCBanditMeta(MetaTechnique):
+    def __init__(self, techniques: Sequence[Technique],
+                 name: Optional[str] = None, C: float = 0.05,
+                 window: int = 500, seed: int = 0):
+        super().__init__(techniques, name)
+        self.bandit = AUCBanditQueue([t.name for t in self.techniques],
+                                     C=C, window=window, seed=seed)
+        self._by_name = {t.name: t for t in self.techniques}
+
+    def select_order(self) -> List[Technique]:
+        return [self._by_name[k] for k in self.bandit.ordered_keys()]
+
+    def credit(self, name: str, was_new_best: bool) -> None:
+        self.bandit.on_result(name, was_new_best)
+
+
+class RoundRobinMeta(MetaTechnique):
+    """metatechniques.py:78-87."""
+
+    def __init__(self, techniques: Sequence[Technique],
+                 name: Optional[str] = None):
+        super().__init__(techniques, name)
+        self._i = 0
+
+    def select_order(self) -> List[Technique]:
+        order = self.techniques[self._i:] + self.techniques[:self._i]
+        self._i = (self._i + 1) % len(self.techniques)
+        return order
+
+
+def _portfolio(name: str, members) -> AUCBanditMeta:
+    return AUCBanditMeta(members, name=name)
+
+
+def _register_portfolios():
+    from .annealing import PseudoAnnealingSearch
+    from .de import DifferentialEvolution
+    from .evolutionary import GreedyMutation, GlobalGA
+    from .pattern import PatternSearch
+    from .pso import PSO
+    from .simplex import NelderMead
+
+    def de_alt():
+        return DifferentialEvolution(cr=0.2, name="DifferentialEvolutionAlt")
+
+    def ugm(**kw):
+        return GreedyMutation(**kw)
+
+    def rnm(name="RandomNelderMead"):
+        return NelderMead(init_style="random", name=name)
+
+    # bandittechniques.py:273-320
+    register(_portfolio("AUCBanditMetaTechniqueA", [
+        de_alt(), ugm(name="UniformGreedyMutation"),
+        ugm(sigma=0.1, mutation_rate=0.3, name="NormalGreedyMutation"),
+        rnm()]))
+    register(_portfolio("AUCBanditMetaTechniqueB", [
+        de_alt(), ugm(name="UniformGreedyMutation")]))
+    register(_portfolio("AUCBanditMetaTechniqueC", [
+        de_alt(), PatternSearch()]))
+    register(_portfolio("PSO_GA_Bandit",
+        [PSO(crossover=cx) for cx in ("OX3", "OX1", "CX", "PMX", "PX")] +
+        [ugm(mutation_rate=0.01, crossover_rate=0.8, crossover=cx,
+             name=f"ga-{cx}") for cx in ("OX3", "OX1", "CX", "PX", "PMX")] +
+        [ugm(mutation_rate=0.01, name="ga-base")]))
+    register(_portfolio("test", [de_alt(), PseudoAnnealingSearch()]))
+    register(_portfolio("test2", [
+        de_alt(), ugm(name="UniformGreedyMutation"),
+        ugm(sigma=0.1, mutation_rate=0.3, name="NormalGreedyMutation"),
+        rnm(), PseudoAnnealingSearch()]))
+    register(_portfolio("PSO_GA_DE",
+        [PSO(crossover=cx) for cx in ("OX1", "PMX", "PX")] +
+        [ugm(crossover_rate=0.5, crossover=cx, name=f"ga-{cx}")
+         for cx in ("OX1", "PMX", "PX")] +
+        [de_alt(),
+         GlobalGA(mutation_rate=0.1, sigma=0.1, crossover_rate=0.5,
+                  crossover_strength=0.2, name="GGA")]))
+
+
+_register_portfolios()

@@ -1,0 +1,160 @@
+"""Technique framework: batched search strategies as pure JAX step functions.
+
+The reference drives techniques one proposal at a time through generator
+objects (`/root/reference/python/uptune/opentuner/search/technique.py:33-363`).
+Here a technique is a *batched state machine*: it owns a pytree of device
+arrays and two pure functions —
+
+    state            = t.init_state(space, key)
+    state, cands     = t.propose(space, state, key, best)     # jittable
+    state            = t.observe(space, state, cands, qor, best)  # jittable
+
+`propose` emits a whole CandBatch (the technique's `natural_batch(space)`
+candidates) per step instead of one config per call; `observe` feeds the
+measured QoR batch back.  Both are wrapped in `jax.jit` by the driver, so a
+full propose→observe cycle is one XLA program per technique.
+
+Conventions:
+
+* QoR is always *minimized* inside the engine (the driver negates for
+  'max' objectives, like the reference's MinimizeTime normal form,
+  `search/objective.py:161-183`).  Missing/failed results are +inf.
+* `best` carries the global best configuration and QoR — the cross-technique
+  information-sharing channel (the reference reads `driver.best_result`,
+  e.g. differentialevolution.py:111-113, evolutionarytechniques.py:90-95).
+* All shapes are static given (space, technique hyperparams); no
+  data-dependent control flow — decisions are `jnp.where` selections.
+
+The registry mirrors the reference's global technique registry
+(`search/technique.py:287-331`): instances registered by name, portfolios
+included.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..space.spec import CandBatch, Space
+
+
+class Best(NamedTuple):
+    """Global best configuration in flat encoding; qor == +inf before any
+    result has been observed."""
+    u: jax.Array                   # [D] f32
+    perms: Tuple[jax.Array, ...]   # each [s_k] i32
+    qor: jax.Array                 # scalar f32
+
+    @staticmethod
+    def empty(space: Space) -> "Best":
+        return Best(
+            jnp.zeros((space.n_scalar,), jnp.float32),
+            tuple(jnp.arange(s, dtype=jnp.int32) for s in space.perm_sizes),
+            jnp.asarray(jnp.inf, jnp.float32))
+
+    def update(self, cands: CandBatch, qor: jax.Array) -> "Best":
+        """Fold a measured batch into the running best (pure, jittable)."""
+        i = jnp.argmin(qor)
+        better = qor[i] < self.qor
+        return Best(
+            jnp.where(better, cands.u[i], self.u),
+            tuple(jnp.where(better, p[i], q)
+                  for p, q in zip(cands.perms, self.perms)),
+            jnp.minimum(self.qor, qor[i]))
+
+    def as_batch(self, n: int) -> CandBatch:
+        return CandBatch(
+            jnp.tile(self.u[None, :], (n, 1)),
+            tuple(jnp.tile(p[None, :], (n, 1)) for p in self.perms))
+
+
+class Technique:
+    """Base class. Subclasses define hyperparameters in __init__ (static
+    Python values — they specialize the jitted step) and implement the three
+    state functions."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or type(self).__name__
+
+    # number of candidates emitted per propose() call
+    def natural_batch(self, space: Space) -> int:
+        raise NotImplementedError
+
+    def supports(self, space: Space) -> bool:
+        """False when the technique degenerates on this space (e.g. simplex
+        methods on a pure-permutation space — the reference logs 'only 1
+        point in simplex, will not use' and exits, simplextechniques.py:284)."""
+        return True
+
+    def init_state(self, space: Space, key: jax.Array):
+        raise NotImplementedError
+
+    def propose(self, space: Space, state, key: jax.Array, best: Best):
+        raise NotImplementedError
+
+    def observe(self, space: Space, state, cands: CandBatch,
+                qor: jax.Array, best: Best):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+# --------------------------------------------------------------------------
+# registry (the equivalent of search/technique.py:287-331)
+# --------------------------------------------------------------------------
+_registry: Dict[str, Technique] = {}
+
+
+def register(t: Technique) -> Technique:
+    if t.name in _registry:
+        raise ValueError(f"duplicate technique name {t.name!r}")
+    _registry[t.name] = t
+    return t
+
+
+def all_technique_names() -> List[str]:
+    _ensure_loaded()
+    return sorted(_registry)
+
+
+def get_technique(name: str) -> Technique:
+    _ensure_loaded()
+    try:
+        return _registry[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown technique {name!r}; known: {sorted(_registry)}") from None
+
+
+def get_root(names: Optional[Sequence[str]] = None) -> Technique:
+    """Resolve --technique args to a root technique: default portfolio when
+    none given, the single technique when one, a round-robin portfolio when
+    several (search/technique.py:345-362)."""
+    _ensure_loaded()
+    from .bandit import RoundRobinMeta  # circular-safe: bandit imports base
+    if not names:
+        return _registry["AUCBanditMetaTechniqueA"]
+    if len(names) == 1:
+        return get_technique(names[0])
+    return RoundRobinMeta([get_technique(n) for n in names],
+                          name="+".join(names))
+
+
+_loaded = False
+
+
+def _ensure_loaded():
+    """Import all technique modules so their register() calls run."""
+    global _loaded
+    if _loaded:
+        return
+    try:
+        from . import purerandom, de, evolutionary, pso, annealing  # noqa: F401
+        from . import pattern, simplex, bandit                      # noqa: F401
+    except Exception:
+        # leave _loaded False so the real import error resurfaces on the
+        # next call instead of an 'unknown technique' on a half registry
+        raise
+    _loaded = True

@@ -1,0 +1,146 @@
+"""Shared batched building blocks used by several techniques.
+
+These encode the reference's per-parameter mutation dispatch
+(`evolutionarytechniques.py:50-115`) over the flat encoding: a "parameter"
+is either one scalar lane or one permutation block, and a mutation pass
+picks, per candidate row, one forced parameter plus a Bernoulli subset of
+the rest (mutation() at evolutionarytechniques.py:50-60).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import numeric as nops
+from ..ops import perm as pops
+from ..space.spec import CandBatch, Space
+
+
+def param_mutation_mask(space: Space, key: jax.Array, n: int,
+                        rate: float, must: int = 1) -> jax.Array:
+    """[n, n_params] bool: per row, `must` forced params (random, distinct)
+    plus coin < rate on the others.  Param order = scalar lanes then perm
+    blocks."""
+    P = space.n_scalar + len(space.perm_sizes)
+    kf, kc = jax.random.split(key)
+    # `must` forced distinct params per row via random scores' top-k
+    scores = jax.random.uniform(kf, (n, P))
+    forced_idx = jnp.argsort(scores, axis=1)[:, :max(0, must)]
+    forced = jnp.zeros((n, P), bool)
+    if must > 0:
+        forced = forced.at[jnp.arange(n)[:, None], forced_idx].set(True)
+    coins = jax.random.uniform(kc, (n, P)) < rate
+    return forced | coins
+
+
+def mutate_perm_random_op(key: jax.Array, pm: jax.Array,
+                          mask: jax.Array) -> jax.Array:
+    """Apply one random permutation manipulator per masked row — the
+    batched `random.choice(param.manipulators(cfg))(cfg)` of
+    evolutionarytechniques.py:113-115.  Ops: shuffle, small random change,
+    random swap, invert (d = n//4 min 1)."""
+    n = pm.shape[1]
+    ks, kc, kw, ki, kp = jax.random.split(key, 5)
+    variants = jnp.stack([
+        pops.shuffle_batch(ks, pm),
+        pops.small_random_change_batch(kc, pm),
+        pops.random_swap_batch(kw, pm),
+        pops.random_invert_batch(ki, pm, max(1, n // 4)),
+    ])  # [4, B, n]
+    pick = jax.random.randint(kp, (pm.shape[0],), 0, 4)
+    chosen = jnp.take_along_axis(
+        variants, pick[None, :, None].astype(jnp.int32), axis=0)[0]
+    return jnp.where(mask[:, None], chosen, pm)
+
+
+def mutate_batch(space: Space, key: jax.Array, cands: CandBatch,
+                 rate: float, must: int = 1,
+                 sigma: Optional[float] = None) -> CandBatch:
+    """One evolutionary mutation pass over a batch.
+
+    sigma=None  -> uniform mutation (op1_randomize per selected param,
+                   UniformGreedyMutation semantics)
+    sigma=float -> normal mutation on primitive lanes, random manipulator
+                   on complex/permutation params (NormalGreedyMutation)
+    """
+    n = cands.batch
+    kmask, kmut, *kperm = jax.random.split(key, 2 + len(space.perm_sizes))
+    mask = param_mutation_mask(space, kmask, n, rate, must)
+    scal_mask = mask[:, :space.n_scalar]
+    if sigma is None:
+        u = nops.randomize(kmut, cands.u, scal_mask)
+    else:
+        u = nops.normal_mutation(kmut, cands.u, sigma,
+                                 space.complex_mask[None, :], scal_mask)
+    perms = []
+    for k_i, (kk, pm) in enumerate(zip(kperm, cands.perms)):
+        pmask = mask[:, space.n_scalar + k_i]
+        if sigma is None:
+            shuf = pops.shuffle_batch(kk, pm)
+            perms.append(jnp.where(pmask[:, None], shuf, pm))
+        else:
+            perms.append(mutate_perm_random_op(kk, pm, pmask))
+    return CandBatch(u, tuple(perms))
+
+
+def perm_codes_equal(p1: jax.Array, p2: jax.Array) -> jax.Array:
+    """[B] bool: rows equal (same_value for permutation blocks)."""
+    return jnp.all(p1 == p2, axis=-1)
+
+
+def de_linear_batch(space: Space, key: jax.Array,
+                    base: CandBatch, x1: CandBatch, x2: CandBatch,
+                    x3: CandBatch, f: jax.Array,
+                    cross_mask: jax.Array) -> CandBatch:
+    """The DE candidate construction: per selected param,
+    cfg = x1 + f*(x2 - x3) (`differentialevolution.py:117-126`).
+
+    Scalar lanes use op4_set_linear math with complex-lane
+    randomize-if-differ degeneration (manipulator.py:523-542, 866-917);
+    permutation blocks copy x1 and reshuffle iff x2 != x3
+    (ComplexParameter.add_difference, manipulator.py:903-917).
+
+    cross_mask: [B, n_params] bool (which params the DE crossover touches);
+    unselected params keep `base` (the member being replaced).
+    f: [B, 1] scale factor.
+    """
+    kc, *kperm = jax.random.split(key, 1 + len(space.perm_sizes))
+    codes2 = space.decode_scalars(x2.u)
+    codes3 = space.decode_scalars(x3.u)
+    u = nops.set_linear(
+        kc, x1.u, x2.u, x3.u, 1.0, f, -f,
+        space.complex_mask[None, :], codes2 == codes3,
+        mask=cross_mask[:, :space.n_scalar], base=base.u)
+    perms = []
+    for k_i, kk in enumerate(kperm):
+        pmask = cross_mask[:, space.n_scalar + k_i]
+        differ = ~perm_codes_equal(x2.perms[k_i], x3.perms[k_i])
+        shuffled = pops.shuffle_batch(kk, x1.perms[k_i])
+        new = jnp.where(differ[:, None], shuffled, x1.perms[k_i])
+        perms.append(jnp.where(pmask[:, None], new, base.perms[k_i]))
+    return CandBatch(u, tuple(perms))
+
+
+def crossover_perms(space: Space, key: jax.Array, child: CandBatch,
+                    a: CandBatch, b: CandBatch, op: str,
+                    strength: float = 1.0 / 3.0,
+                    min_size: int = 7) -> CandBatch:
+    """Apply permutation crossover `op` (PX/PMX/CX/OX1/OX3) between parents
+    a and b on every perm block of size >= min_size, writing into `child`'s
+    perm slots (GA CrossoverMixin, evolutionarytechniques.py:117-133:
+    only perm params with size > 6, d = size/3)."""
+    if not space.perm_sizes:
+        return child
+    fn = pops.CROSSOVERS[op]
+    keys = jax.random.split(key, len(space.perm_sizes))
+    perms = []
+    for kk, pa, pb, size in zip(keys, a.perms, b.perms, space.perm_sizes):
+        if size >= min_size:
+            d = max(1, int(round(size * strength)))
+            vm = jax.vmap(lambda k, x, y: fn(k, x, y, d))
+            perms.append(vm(jax.random.split(kk, pa.shape[0]), pa, pb))
+        else:
+            perms.append(pa)
+    return CandBatch(child.u, tuple(perms))

@@ -1,0 +1,117 @@
+"""Batched differential evolution.
+
+Reference: `/root/reference/python/uptune/opentuner/search/
+differentialevolution.py:29-151`.  The reference replaces one population
+member per `desired_configuration()` call (oldest first); the batched
+re-design advances the *whole population* per step: every member proposes
+its replacement candidate simultaneously (classic synchronous DE, which is
+the natural TPU formulation), with the reference's information-sharing slot
+(global best appended to the parent pool, :111-113) and its crossover rule
+(per-param coin < cr with n_cross forced, cfg = x1 + F*(x2-x3),
+F ~ U(0.5, 1), :117-126).
+
+The first propose() call emits the freshly-randomized initial population
+itself (initial_population + submitted bookkeeping, :54-85); observe()
+then fills in member QoRs and replacement begins.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..space.spec import CandBatch, Space
+from .base import Best, Technique, register
+from .common import de_linear_batch, param_mutation_mask
+
+
+class DEState(NamedTuple):
+    pop: CandBatch        # [P, ...] member configurations
+    qor: jax.Array        # [P] member QoR (+inf = not yet measured)
+    bootstrapped: jax.Array  # scalar bool: initial population submitted?
+
+
+class DifferentialEvolution(Technique):
+    def __init__(self, population_size: int = 30, cr: float = 0.9,
+                 n_cross: int = 1, information_sharing: int = 1,
+                 name: str = "DifferentialEvolution"):
+        super().__init__(name)
+        self.population_size = population_size
+        self.cr = cr
+        self.n_cross = n_cross
+        self.information_sharing = information_sharing
+
+    def natural_batch(self, space: Space) -> int:
+        return self.population_size
+
+    def init_state(self, space: Space, key: jax.Array) -> DEState:
+        pop = space.random(key, self.population_size)
+        return DEState(pop, jnp.full((self.population_size,), jnp.inf),
+                       jnp.asarray(False))
+
+    def propose(self, space: Space, state: DEState, key: jax.Array,
+                best: Best) -> Tuple[DEState, CandBatch]:
+        P = self.population_size
+        kpar, kf, kmask, klin = jax.random.split(key, 4)
+
+        # parent pool per member i: the P-1 other members plus
+        # `information_sharing` copies of the global best; fall back to the
+        # member itself while no best exists (first generation).
+        n_pool = P - 1 + self.information_sharing
+        picks = jax.vmap(
+            lambda k: jax.random.choice(k, n_pool, (3,), replace=False)
+        )(jax.random.split(kpar, P))                     # [P, 3] pool indices
+        member = jnp.arange(P)[:, None]                  # [P, 1]
+        # pool index -> population index (skip self), >= P-1 means "best"
+        pop_idx = jnp.where(picks >= member, picks + 1, picks)
+        is_best = picks >= (P - 1)
+        have_best = jnp.isfinite(best.qor)
+
+        def gather(x_pop, x_best):
+            # x_pop: [P, ...]; select parent rows, substituting best
+            rows = x_pop[jnp.clip(pop_idx, 0, P - 1)]    # [P, 3, ...]
+            bcast = jnp.broadcast_to(
+                x_best, (P, 3) + x_best.shape)
+            use_best = (is_best & have_best)
+            while use_best.ndim < rows.ndim:
+                use_best = use_best[..., None]
+            return jnp.where(use_best, bcast, rows)
+
+        xs_u = gather(state.pop.u, best.u)               # [P, 3, D]
+        xs_perms = tuple(gather(pp, bp)
+                         for pp, bp in zip(state.pop.perms, best.perms))
+
+        def parent(j: int) -> CandBatch:
+            return CandBatch(xs_u[:, j], tuple(p[:, j] for p in xs_perms))
+
+        f = (jax.random.uniform(kf, (P, 1)) / 2.0 + 0.5)  # U(0.5, 1), :119
+        cross = param_mutation_mask(space, kmask, P, self.cr, self.n_cross)
+        cands = de_linear_batch(space, klin, state.pop, parent(0), parent(1),
+                                parent(2), f, cross)
+        cands = space.normalize(cands)
+
+        # bootstrap: emit the unsubmitted initial population instead
+        boot = state.bootstrapped
+        out = CandBatch(
+            jnp.where(boot, cands.u, state.pop.u),
+            tuple(jnp.where(boot, c, p)
+                  for c, p in zip(cands.perms, state.pop.perms)))
+        return state._replace(bootstrapped=jnp.asarray(True)), out
+
+    def observe(self, space: Space, state: DEState, cands: CandBatch,
+                qor: jax.Array, best: Best) -> DEState:
+        # candidate i replaces member i if strictly better (:133-140);
+        # also covers the bootstrap generation (member qor = +inf).
+        better = qor < state.qor
+        pop = CandBatch(
+            jnp.where(better[:, None], cands.u, state.pop.u),
+            tuple(jnp.where(better[:, None], c, p)
+                  for c, p in zip(cands.perms, state.pop.perms)))
+        return DEState(pop, jnp.minimum(state.qor, qor), state.bootstrapped)
+
+
+register(DifferentialEvolution())
+register(DifferentialEvolution(cr=0.2, name="DifferentialEvolutionAlt"))
+register(DifferentialEvolution(population_size=100, cr=0.2,
+                               name="DifferentialEvolution_20_100"))

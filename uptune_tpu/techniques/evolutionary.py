@@ -1,0 +1,108 @@
+"""Greedy evolutionary techniques, batched.
+
+Reference: `/root/reference/python/uptune/opentuner/search/
+evolutionarytechniques.py` (local mutation of the global best) and
+`globalGA.py` (adds whole-value crossover copy).  Greedy selection always
+picks the incumbent global best (GreedySelectionMixin, :85-95), so a batched
+step emits N independent mutations of the best configuration — the batch
+generalization of N sequential desired_configuration() calls.
+
+Mutation semantics (mutation(), :50-60): shuffle parameter order, mutate the
+first `must_mutate_count` unconditionally and each other with probability
+`mutation_rate`.  Uniform variant randomizes the chosen parameter
+(op1_randomize); Normal variant applies sigma-scaled Gaussian noise to
+primitive parameters and a random manipulator to complex ones (:97-115).
+
+GA (CrossoverMixin, :117-133) crosses the permutation blocks of two selected
+parents with a named crossover at d = size/3 for blocks larger than 6.  With
+greedy selection both parents are the same incumbent, so the cross is an
+identity on paper — we keep the call for parity (it matters when the
+selection rule is changed) but route it through the same batched kernels.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..space.spec import CandBatch, Space
+from .base import Best, Technique, register
+from .common import crossover_perms, mutate_batch
+
+
+class GreedyMutation(Technique):
+    """UniformGreedyMutation / NormalGreedyMutation / GA / GGA family."""
+
+    def __init__(self, batch: int = 32, mutation_rate: float = 0.1,
+                 crossover_rate: float = 0.0, must_mutate_count: int = 1,
+                 sigma: Optional[float] = None,
+                 crossover: Optional[str] = None,
+                 crossover_strength: float = 1.0 / 3.0,
+                 name: str = "GreedyMutation"):
+        super().__init__(name)
+        self.batch = batch
+        self.mutation_rate = mutation_rate
+        self.crossover_rate = crossover_rate
+        self.must_mutate_count = must_mutate_count
+        self.sigma = sigma
+        self.crossover = crossover
+        self.crossover_strength = crossover_strength
+
+    def natural_batch(self, space: Space) -> int:
+        return self.batch
+
+    def init_state(self, space: Space, key: jax.Array):
+        return ()
+
+    def propose(self, space: Space, state, key: jax.Array,
+                best: Best) -> Tuple[tuple, CandBatch]:
+        n = self.batch
+        krand, kx, kxsel, kmut = jax.random.split(key, 4)
+        # parent = incumbent best tiled; before any result exists every row
+        # falls back to an independent random config (GreedySelectionMixin)
+        fallback = space.random(krand, n)
+        have = jnp.isfinite(best.qor)
+        parent = CandBatch(
+            jnp.where(have, jnp.tile(best.u[None, :], (n, 1)), fallback.u),
+            tuple(jnp.where(have, jnp.tile(p[None, :], (n, 1)), f)
+                  for p, f in zip(best.perms, fallback.perms)))
+        cands = parent
+        if self.crossover is not None and space.perm_sizes:
+            crossed = crossover_perms(space, kx, parent, parent, parent,
+                                      self.crossover, self.crossover_strength)
+            do = jax.random.uniform(kxsel, (n, 1)) < self.crossover_rate
+            cands = CandBatch(cands.u, tuple(
+                jnp.where(do, c, p)
+                for c, p in zip(crossed.perms, cands.perms)))
+        cands = mutate_batch(space, kmut, cands, self.mutation_rate,
+                             self.must_mutate_count, self.sigma)
+        return state, space.normalize(cands)
+
+    def observe(self, space, state, cands, qor, best):
+        return state
+
+
+class GlobalGA(GreedyMutation):
+    """globalGA.py: crossover copies `crossover_strength * n_params` random
+    parameter values from parent 2 into parent 1 (:68-76) before mutation.
+    With greedy selection both parents are the incumbent best so the copy is
+    an identity; kept for structural parity."""
+    pass
+
+
+def _register_all():
+    for cx in ("OX3", "OX1", "PX", "CX", "PMX"):
+        register(GreedyMutation(mutation_rate=0.10, crossover_rate=0.8,
+                                crossover=cx, name=f"ga-{cx}"))
+    register(GreedyMutation(mutation_rate=0.10, name="ga-base"))
+    for rate in (0.05, 0.10, 0.20):
+        register(GreedyMutation(mutation_rate=rate,
+                                name=f"UniformGreedyMutation{int(rate*100):02d}"))
+        register(GreedyMutation(mutation_rate=rate, sigma=0.1,
+                                name=f"NormalGreedyMutation{int(rate*100):02d}"))
+    register(GlobalGA(mutation_rate=0.1, sigma=0.1, crossover_rate=0.5,
+                      crossover_strength=0.2, name="GGA"))
+
+
+_register_all()

@@ -1,0 +1,86 @@
+"""Batched pattern (compass) search.
+
+Reference: `/root/reference/python/uptune/opentuner/search/
+patternsearch.py:5-68` — keep a center config and step size, propose
+up/down unit-space moves for every primitive parameter (random manipulators
+for complex ones), move the center to the best improving point or halve the
+step; adopt the global best if another technique found better.
+
+Batched: one step samples `batch` random (parameter, direction) moves at
+the current step size (fixed batch shape instead of 2·D proposals), and the
+accept/shrink decision runs in observe().
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..space.spec import CandBatch, Space
+from .base import Best, Technique, register
+from .common import mutate_perm_random_op
+
+
+class PatternState(NamedTuple):
+    center: CandBatch      # [1, ...]
+    center_qor: jax.Array  # scalar
+    step: jax.Array        # scalar f32
+
+
+class PatternSearch(Technique):
+    def __init__(self, batch: int = 32, initial_step: float = 0.1,
+                 name: str = "PatternSearch"):
+        super().__init__(name)
+        self.batch = batch
+        self.initial_step = initial_step
+
+    def natural_batch(self, space: Space) -> int:
+        return self.batch
+
+    def init_state(self, space: Space, key: jax.Array) -> PatternState:
+        center = space.random(key, 1)
+        return PatternState(center, jnp.asarray(jnp.inf),
+                            jnp.asarray(self.initial_step, jnp.float32))
+
+    def propose(self, space: Space, state: PatternState, key: jax.Array,
+                best: Best) -> Tuple[PatternState, CandBatch]:
+        n = self.batch
+        kd, kdir, *kperm = jax.random.split(key, 2 + len(space.perm_sizes))
+        P = space.n_scalar + len(space.perm_sizes)
+        which = jax.random.randint(kd, (n,), 0, P)
+        direction = jnp.where(jax.random.uniform(kdir, (n, 1)) < 0.5, -1.0, 1.0)
+        base_u = jnp.tile(state.center.u, (n, 1))
+        lane_sel = which[:, None] == jnp.arange(space.n_scalar)[None, :]
+        u = jnp.clip(base_u + lane_sel * direction * state.step, 0.0, 1.0)
+        perms = []
+        for k_i, kk in enumerate(kperm):
+            pm = jnp.tile(state.center.perms[k_i], (n, 1))
+            sel = which == (space.n_scalar + k_i)
+            perms.append(mutate_perm_random_op(kk, pm, sel))
+        return state, space.normalize(CandBatch(u, tuple(perms)))
+
+    def observe(self, space: Space, state: PatternState, cands: CandBatch,
+                qor: jax.Array, best: Best) -> PatternState:
+        i = jnp.argmin(qor)
+        best_pt_qor = qor[i]
+        improved = best_pt_qor < state.center_qor
+        # priority: global best found elsewhere > improving point > shrink
+        # (patternsearch.py:54-63)
+        adopt_global = (best.qor < state.center_qor) & (best.qor < best_pt_qor)
+        new_u = jnp.where(adopt_global, best.u,
+                          jnp.where(improved, cands.u[i], state.center.u[0]))
+        new_perms = tuple(
+            jnp.where(adopt_global, b,
+                      jnp.where(improved, c[i], p[0]))
+            for b, c, p in zip(best.perms, cands.perms, state.center.perms))
+        new_qor = jnp.where(adopt_global, best.qor,
+                            jnp.minimum(state.center_qor, best_pt_qor))
+        shrink = (~improved) & (~adopt_global)
+        new_step = jnp.where(shrink, state.step * 0.5, state.step)
+        return PatternState(
+            CandBatch(new_u[None, :], tuple(p[None, :] for p in new_perms)),
+            new_qor, new_step)
+
+
+register(PatternSearch())

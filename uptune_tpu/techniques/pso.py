@@ -1,0 +1,114 @@
+"""Batched particle swarm optimization.
+
+Reference: `/root/reference/python/uptune/opentuner/search/pso.py:11-84` —
+N=30 HybridParticles, each holding position, per-parameter velocity, and a
+local best; every move calls op3_swarm per parameter with
+(c=omega=0.5, phi_g=0.5, phi_l=0.5).
+
+Batched: positions/velocities are [N, D] arrays; one propose() moves every
+particle (the reference moves them one per desired_result call — same
+trajectory distribution, N× the throughput).  Scalar lanes follow the
+float/int op3_swarm velocity form, BOOL lanes the sigmoid-coin form, other
+complex lanes the stochastic (current/local/global) mix — see
+ops.numeric.swarm.  Permutation blocks follow PermutationParameter.op3_swarm
+(manipulator.py:1115-1141): with probability 1-c, cross the position with
+the global (phi_g) or local (phi_l) best using the technique's crossover
+choice at strength 0.3.
+
+First propose() emits the initial random positions (pso.py:35-37).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import numeric as nops
+from ..ops import perm as pops
+from ..space.spec import CandBatch, Space
+from .base import Best, Technique, register
+
+
+class PSOState(NamedTuple):
+    pos: CandBatch          # [N, ...] particle positions
+    vel: jax.Array          # [N, D] scalar-lane velocities
+    lbest: CandBatch        # [N, ...] per-particle best position
+    lbest_qor: jax.Array    # [N]
+    bootstrapped: jax.Array
+
+
+class PSO(Technique):
+    def __init__(self, crossover: str = "OX1", N: int = 30,
+                 omega: float = 0.5, phi_l: float = 0.5, phi_g: float = 0.5,
+                 name: str = None):
+        super().__init__(name or f"pso-{crossover}")
+        self.crossover = crossover
+        self.N = N
+        self.omega = omega
+        self.phi_l = phi_l
+        self.phi_g = phi_g
+
+    def natural_batch(self, space: Space) -> int:
+        return self.N
+
+    def init_state(self, space: Space, key: jax.Array) -> PSOState:
+        pos = space.random(key, self.N)
+        return PSOState(pos, jnp.zeros((self.N, space.n_scalar)),
+                        pos, jnp.full((self.N,), jnp.inf),
+                        jnp.asarray(False))
+
+    def propose(self, space: Space, state: PSOState, key: jax.Array,
+                best: Best) -> Tuple[PSOState, CandBatch]:
+        N = self.N
+        ks, kg, kc1, kc2, *kperm = jax.random.split(
+            key, 4 + len(space.perm_sizes))
+        have = jnp.isfinite(best.qor)
+        gbest_u = jnp.where(have, best.u, state.pos.u[0])
+        bool_mask = (space.kind == 5)[None, :]  # P.BOOL
+        new_u, new_vel = nops.swarm(
+            ks, state.pos.u, state.lbest.u, gbest_u[None, :], state.vel,
+            space.complex_mask[None, :], bool_mask,
+            c=self.omega, c1=self.phi_l, c2=self.phi_g)
+
+        # permutation blocks: probabilistic crossover with local/global best
+        perms = []
+        coin_move = jax.random.uniform(kc1, (N, 1)) > self.omega
+        coin_partner = jax.random.uniform(kc2, (N, 1)) < self.phi_g
+        fn = pops.CROSSOVERS[self.crossover]
+        for kk, pm, lb, gb, size in zip(
+                kperm, state.pos.perms, state.lbest.perms, best.perms,
+                space.perm_sizes):
+            d = max(1, int(round(size * 0.3)))
+            gb_rows = jnp.tile(gb[None, :], (N, 1))
+            gb_rows = jnp.where(have, gb_rows, pm)
+            keys = jax.random.split(kk, N)
+            vm = jax.vmap(lambda k, a, b: fn(k, a, b, d))
+            with_g = vm(keys, pm, gb_rows)
+            with_l = vm(keys, pm, lb)
+            crossed = jnp.where(coin_partner, with_g, with_l)
+            perms.append(jnp.where(coin_move, crossed, pm))
+
+        moved = space.normalize(CandBatch(new_u, tuple(perms)))
+        boot = state.bootstrapped
+        out = CandBatch(
+            jnp.where(boot, moved.u, state.pos.u),
+            tuple(jnp.where(boot, m, p)
+                  for m, p in zip(moved.perms, state.pos.perms)))
+        vel = jnp.where(boot, new_vel, state.vel)
+        return PSOState(out, vel, state.lbest, state.lbest_qor,
+                        jnp.asarray(True)), out
+
+    def observe(self, space: Space, state: PSOState, cands: CandBatch,
+                qor: jax.Array, best: Best) -> PSOState:
+        better = qor < state.lbest_qor
+        lbest = CandBatch(
+            jnp.where(better[:, None], cands.u, state.lbest.u),
+            tuple(jnp.where(better[:, None], c, p)
+                  for c, p in zip(cands.perms, state.lbest.perms)))
+        return state._replace(lbest=lbest,
+                              lbest_qor=jnp.minimum(state.lbest_qor, qor))
+
+
+for _cx in ("OX3", "OX1", "PMX", "PX", "CX"):
+    register(PSO(crossover=_cx))

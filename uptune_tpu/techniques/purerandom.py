@@ -1,0 +1,30 @@
+"""Uniform random search (`PureRandom`, reference
+`/root/reference/python/uptune/opentuner/search/technique.py:177-182,303`).
+Stateless: every step emits a fresh uniform batch."""
+from __future__ import annotations
+
+import jax
+
+from ..space.spec import Space
+from .base import Best, Technique, register
+
+
+class PureRandom(Technique):
+    def __init__(self, batch: int = 64, name: str = "PureRandom"):
+        super().__init__(name)
+        self.batch = batch
+
+    def natural_batch(self, space: Space) -> int:
+        return self.batch
+
+    def init_state(self, space: Space, key: jax.Array):
+        return ()
+
+    def propose(self, space: Space, state, key: jax.Array, best: Best):
+        return state, space.random(key, self.batch)
+
+    def observe(self, space, state, cands, qor, best):
+        return state
+
+
+register(PureRandom())
