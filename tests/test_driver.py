@@ -1,0 +1,213 @@
+"""Driver-layer tests: history dedup, Tuner convergence, archive/resume.
+
+Modeled on the reference's own framework fixtures (samples/rosenbrock,
+samples/tsp — SURVEY.md §4) but with real assertions and seeded RNG.
+"""
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uptune_tpu.driver import History, Tuner, dup_source, unique_mask
+from uptune_tpu.space.params import EnumParam, FloatParam, IntParam
+from uptune_tpu.space.spec import Space
+from uptune_tpu.workloads import (
+    random_tsp_distances, rosenbrock_objective, rosenbrock_space,
+    sphere_device, tsp_objective, tsp_space, make_host_objective)
+
+
+# -- history ---------------------------------------------------------------
+def _hashes(rows):
+    return jnp.asarray(np.asarray(rows, np.uint32))
+
+
+class TestHistory:
+    def test_insert_contains_roundtrip(self):
+        h = History(capacity=64)
+        st = h.init()
+        hs = _hashes([[1, 2], [3, 4], [5, 6]])
+        qor = jnp.asarray([10.0, 20.0, 30.0])
+        st = h.insert(st, hs, qor, jnp.ones(3, bool))
+        found, known = h.contains(st, hs)
+        assert found.all()
+        np.testing.assert_allclose(np.asarray(known), [10.0, 20.0, 30.0])
+        miss, _ = h.contains(st, _hashes([[7, 8]]))
+        assert not miss.any()
+
+    def test_same_h0_different_h1(self):
+        h = History(capacity=64)
+        st = h.init()
+        hs = _hashes([[1, 2], [1, 3], [1, 4]])
+        st = h.insert(st, hs, jnp.asarray([1.0, 2.0, 3.0]), jnp.ones(3, bool))
+        found, known = h.contains(st, _hashes([[1, 4], [1, 2], [1, 9]]))
+        assert list(np.asarray(found)) == [True, True, False]
+        np.testing.assert_allclose(np.asarray(known)[:2], [3.0, 1.0])
+
+    def test_invalid_rows_not_inserted(self):
+        h = History(capacity=64)
+        st = h.init()
+        hs = _hashes([[1, 2], [3, 4]])
+        st = h.insert(st, hs, jnp.asarray([1.0, 2.0]),
+                      jnp.asarray([True, False]))
+        found, _ = h.contains(st, hs)
+        assert list(np.asarray(found)) == [True, False]
+        assert int(st.n) == 1
+
+    def test_capacity_overflow_keeps_count_bounded(self):
+        h = History(capacity=8)
+        st = h.init()
+        hs = _hashes([[i, i] for i in range(16)])
+        st = h.insert(st, hs, jnp.arange(16.0), jnp.ones(16, bool))
+        assert int(st.n) == 8
+
+    def test_unique_mask_and_dup_source(self):
+        hs = _hashes([[1, 1], [2, 2], [1, 1], [3, 3], [2, 2], [1, 1]])
+        m = np.asarray(unique_mask(hs))
+        assert list(m) == [True, True, False, True, False, False]
+        src = np.asarray(dup_source(hs))
+        assert list(src) == [0, 1, 0, 3, 1, 0]
+
+
+# -- tuner -----------------------------------------------------------------
+class TestTuner:
+    def test_rosenbrock_float_converges(self):
+        space = rosenbrock_space(2, -3.0, 3.0)
+        t = Tuner(space, rosenbrock_objective(2), seed=1)
+        res = t.run(test_limit=1500)
+        assert res.best_qor < 1.0, res.best_qor
+        assert res.evals >= 1500
+        # trace is the non-increasing best-so-far curve
+        assert all(b <= a + 1e-9 for a, b in zip(res.trace, res.trace[1:]))
+
+    def test_sphere_int_space_exact(self):
+        space = rosenbrock_space(3, -20, 20, as_int=True)
+        obj = make_host_objective(sphere_device, 3)
+        t = Tuner(space, obj, seed=0, technique="DifferentialEvolution")
+        res = t.run(test_limit=800)
+        assert res.best_qor <= 3.0
+        for i in range(3):
+            assert isinstance(res.best_config[f"x{i}"], int)
+
+    def test_maximize_sense(self):
+        space = Space([FloatParam("x", 0.0, 10.0)])
+
+        def obj(cfgs):
+            return [-(c["x"] - 7.0) ** 2 for c in cfgs]
+
+        t = Tuner(space, obj, sense="max", seed=3)
+        res = t.run(test_limit=600)
+        assert res.best_qor > -0.05
+        assert abs(res.best_config["x"] - 7.0) < 0.3
+
+    def test_tsp_converges(self):
+        n = 8
+        dist = random_tsp_distances(n, seed=4)
+        t = Tuner(tsp_space(n), tsp_objective(dist), seed=5,
+                  technique="PSO_GA_Bandit")
+        res = t.run(test_limit=1200)
+        # brute-force optimum for 8 cities
+        import itertools
+        best = min(
+            sum(dist[p[i], p[(i + 1) % n]] for i in range(n))
+            for p in itertools.permutations(range(1, n), n - 1)
+            for p in [(0,) + p])
+        assert res.best_qor <= best * 1.15, (res.best_qor, best)
+
+    def test_failure_qor_inf(self):
+        space = Space([FloatParam("x", 0.0, 1.0)])
+
+        def obj(cfgs):
+            return [float("nan") if c["x"] < 0.5 else c["x"] for c in cfgs]
+
+        t = Tuner(space, obj, seed=0)
+        res = t.run(test_limit=200)
+        assert math.isfinite(res.best_qor)
+        assert res.best_qor >= 0.5
+
+    def test_failure_qor_inf_max_sense(self):
+        # a NaN under sense='max' must NOT become an unbeatable -inf best
+        space = Space([FloatParam("x", 0.0, 1.0)])
+
+        def obj(cfgs):
+            return [float("nan") if c["x"] < 0.5 else c["x"] for c in cfgs]
+
+        t = Tuner(space, obj, sense="max", seed=0)
+        res = t.run(test_limit=200)
+        assert math.isfinite(res.best_qor)
+        assert res.best_qor >= 0.9
+        assert res.best_config["x"] >= 0.5
+
+    def test_no_duplicate_evaluations(self):
+        # tiny discrete space: 12 configs; dedup must stop re-evaluating
+        space = Space([IntParam("a", 0, 3), EnumParam("e", ("p", "q", "r"))])
+        seen = []
+
+        def obj(cfgs):
+            seen.extend(tuple(sorted(c.items())) for c in cfgs)
+            return [hash(tuple(sorted(c.items()))) % 7 for c in cfgs]
+
+        t = Tuner(space, obj, seed=2, technique="UniformGreedyMutation05")
+        t.run(test_limit=60)
+        assert len(seen) == len(set(seen)), "duplicate evaluation slipped through"
+
+    def test_bandit_portfolio_runs_all_arms_eventually(self):
+        space = rosenbrock_space(2, -5.0, 5.0)
+        t = Tuner(space, rosenbrock_objective(2), seed=7)
+        used = set()
+        for _ in range(40):
+            used.add(t.step().technique)
+        assert len(used) >= 2, used
+
+
+class TestArchiveResume:
+    def test_archive_written_and_resumed(self, tmp_path):
+        space = rosenbrock_space(2, -3.0, 3.0)
+        arc = str(tmp_path / "archive.jsonl")
+        with Tuner(space, rosenbrock_objective(2), seed=1, archive=arc) as t:
+            r1 = t.run(test_limit=300)
+        rows = [json.loads(l) for l in open(arc)]
+        assert len(rows) == r1.evals
+        assert {"gid", "time", "cfg", "u", "perms", "qor", "best"} <= set(rows[0])
+        # resume: history pre-populated, best restored, evals counted
+        with Tuner(space, rosenbrock_objective(2), seed=9, archive=arc,
+                   resume=True) as t2:
+            assert t2.evals == r1.evals
+            assert abs(float(t2.best.qor) - r1.best_qor) < 1e-5
+            r2 = t2.run(test_limit=r1.evals + 200)
+        assert r2.best_qor <= r1.best_qor + 1e-9
+
+    def test_resume_space_mismatch_rotates_archive(self, tmp_path):
+        import os
+        arc = str(tmp_path / "archive.jsonl")
+        space = rosenbrock_space(2, -3.0, 3.0)
+        with Tuner(space, rosenbrock_objective(2), seed=1, archive=arc) as t:
+            t.run(test_limit=60)
+        other = Space([FloatParam("y", 0.0, 1.0)])
+
+        def obj(cfgs):
+            return [c["y"] for c in cfgs]
+
+        with pytest.warns(UserWarning, match="different space"):
+            t2 = Tuner(other, obj, archive=arc, resume=True)
+        assert t2.evals == 0
+        # old records moved aside, not mixed into the new archive
+        assert os.path.exists(arc + ".mismatch")
+        t2.run(test_limit=20)
+        t2.close()
+        rows = [json.loads(l) for l in open(arc)]
+        assert all(set(r["cfg"]) == {"y"} for r in rows)
+
+    def test_resume_survives_torn_tail(self, tmp_path):
+        arc = str(tmp_path / "archive.jsonl")
+        space = rosenbrock_space(2, -3.0, 3.0)
+        with Tuner(space, rosenbrock_objective(2), seed=1, archive=arc) as t:
+            t.run(test_limit=60)
+        with open(arc) as f:
+            data = f.read()
+        with open(arc, "w") as f:
+            f.write(data[:-25])  # cut mid-record
+        t2 = Tuner(space, rosenbrock_objective(2), archive=arc, resume=True)
+        assert 0 < t2.evals < 60 + 40

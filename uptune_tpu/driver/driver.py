@@ -1,0 +1,355 @@
+"""The tuning driver: batched acquisition loop over on-device techniques.
+
+Host-side replacement for the reference's controller + search-driver pair
+(`/root/reference/python/uptune/api.py:399-594` `async_execute` and
+`opentuner/search/driver.py:160-225`), re-shaped for TPU batching:
+
+* each step, the meta-technique (AUC bandit) orders its arms host-side and
+  the first supported arm emits a whole CandBatch from one jitted XLA
+  program (vs. one config per `desired_result()` call);
+* dedup + known-result reuse run on device against the sorted-hash history
+  (driver/history.py) instead of per-proposal SQL lookups;
+* only hash-novel candidates cross the host boundary for black-box
+  evaluation; in-batch duplicates share one evaluation, history duplicates
+  are served their recorded QoR (api.py:276-286 semantics);
+* every evaluated trial is appended to a jsonl archive carrying the raw
+  unit vectors, so `resume()` replays *exactly* (the reference's
+  ut.archive.csv + `resume`, api.py:328-363,536-543).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..space.spec import CandBatch, Space
+from ..techniques import base as tbase
+from ..techniques.base import Best, Technique
+from ..techniques.bandit import MetaTechnique
+from .history import History, dup_source
+
+Objective = Callable[[List[Dict[str, Any]]], Sequence[float]]
+
+
+class StepStats(NamedTuple):
+    step: int
+    technique: str
+    batch: int
+    evaluated: int
+    best_qor: float
+    was_new_best: bool
+
+
+class TuneResult(NamedTuple):
+    best_config: Dict[str, Any]
+    best_qor: float          # in USER orientation (negated back for 'max')
+    evals: int
+    steps: int
+    trace: List[float]       # best-so-far (user orientation) after each eval
+
+
+class Tuner:
+    """Single-instance batched tuner over an in-process objective.
+
+    Parameters
+    ----------
+    space : Space
+    objective : callable(list[config dict]) -> sequence of float
+        QoR per config; non-finite values count as failures (+inf).
+    technique : str | list[str] | Technique | None
+        As the reference's --technique flag (technique.py:345-362);
+        default is the AUCBanditMetaTechniqueA portfolio.
+    sense : 'min' | 'max'
+        User objective orientation; engine always minimizes
+        (objective.py:161-183 normal form).
+    archive : optional path of the jsonl trial archive (resume source).
+    """
+
+    def __init__(self, space: Space, objective: Objective, *,
+                 technique=None, seed: int = 0, sense: str = "min",
+                 capacity: int = 1 << 16,
+                 archive: Optional[str] = None,
+                 resume: bool = False):
+        assert sense in ("min", "max"), sense
+        self.space = space
+        self.objective = objective
+        self.sense = sense
+        self.sign = 1.0 if sense == "min" else -1.0
+        self.key = jax.random.PRNGKey(seed)
+        self.history = History(capacity)
+        self.hist_state = self.history.init()
+        self.best = Best.empty(space)
+        self.archive_path = archive
+        self.evals = 0
+        self.steps = 0
+        self.gid = 0
+        self.trace: List[float] = []
+        self._zero_novel_streak = 0
+        self._cap_warned = False
+
+        root = technique
+        if root is None or isinstance(root, str) or (
+                isinstance(root, (list, tuple))):
+            names = ([root] if isinstance(root, str) else root)
+            root = tbase.get_root(names)
+        # registry entries are shared singletons; meta-techniques carry
+        # mutable host-side bandit credit state, so each Tuner gets its own
+        # copy (the reference creates techniques fresh per tuning run)
+        import copy
+        self.root: Technique = copy.deepcopy(root)
+        root = self.root
+        members = (root.techniques if isinstance(root, MetaTechnique)
+                   else [root])
+        self.members: List[Technique] = [
+            t for t in members if t.supports(space)]
+        if not self.members:
+            raise ValueError(
+                f"no technique in {root.name!r} supports this space")
+        self._tstates: Dict[str, Any] = {}
+        self._propose_jit: Dict[str, Any] = {}
+        self._observe_jit: Dict[str, Any] = {}
+        for t in self.members:
+            self.key, k = jax.random.split(self.key)
+            self._tstates[t.name] = t.init_state(space, k)
+            self._propose_jit[t.name] = jax.jit(
+                lambda st, k, best, _t=t: _t.propose(space, st, k, best))
+            self._observe_jit[t.name] = jax.jit(
+                lambda st, c, q, best, _t=t: _t.observe(space, st, c, q, best))
+
+        sp, hist = self.space, self.history
+
+        @jax.jit
+        def _dedup(hist_state, cands: CandBatch):
+            hashes = sp.hash_batch(cands)
+            found, known = hist.contains(hist_state, hashes)
+            src = dup_source(hashes)
+            first = src == jnp.arange(hashes.shape[0])
+            novel = first & ~found
+            return hashes, found, known, src, novel
+
+        @jax.jit
+        def _commit(hist_state, best, hashes, cands: CandBatch, qor,
+                    newly):
+            hist_state = hist.insert(hist_state, hashes, qor, newly)
+            best = best.update(cands, qor)
+            return hist_state, best
+
+        self._dedup = _dedup
+        self._commit = _commit
+
+        if resume and archive and os.path.exists(archive):
+            self._resume(archive)
+        self._archive_f = open(archive, "a") if archive else None
+
+    # ------------------------------------------------------------------
+    def _resume(self, path: str) -> None:
+        """Replay the jsonl archive: exact unit vectors -> history + best
+        (reference resume(), api.py:328-363 — replayed as technique 'seed',
+        i.e. without touching technique states)."""
+        rows = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        rows.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        break  # torn tail write; ignore the rest
+        if not rows:
+            return
+        # column check: archive must match the current space; the reference
+        # deletes a mismatched archive (api.py:334-339) — we rotate it
+        # aside so mixed-space records never share one file
+        names = set(rows[0]["cfg"])
+        if names != {s.name for s in self.space.specs}:
+            import warnings
+            bak = path + ".mismatch"
+            os.replace(path, bak)
+            warnings.warn(
+                f"archive {path} was recorded for a different space "
+                f"(params {sorted(names)}); moved aside to {bak}")
+            return
+        B = len(rows)
+        u = np.asarray([r["u"] for r in rows], np.float32)
+        perms = tuple(
+            np.asarray([r["perms"][k] for r in rows], np.int32)
+            for k in range(len(self.space.perm_sizes)))
+        # archive rows are user-oriented; engine-internal = sign * user
+        qor = self.sign * np.asarray([r["qor"] for r in rows], np.float32)
+        cands = CandBatch(jnp.asarray(u), tuple(jnp.asarray(p) for p in perms))
+        hashes, found, known, src, novel = self._dedup(self.hist_state, cands)
+        self.hist_state, self.best = self._commit(
+            self.hist_state, self.best, hashes, cands, jnp.asarray(qor),
+            novel)
+        self.gid = max(int(r["gid"]) for r in rows) + 1
+        self.evals = len(rows)
+        running = float("inf")
+        for q in qor:
+            running = min(running, float(q))
+            self.trace.append(self.sign * running)
+
+    def _log_trial(self, cfg, u_row, perm_rows, qor, is_best, dur) -> None:
+        self.gid += 1
+        if self._archive_f is None:
+            return
+        rec = {"gid": self.gid - 1, "time": round(dur, 6), "cfg": cfg,
+               "u": [float(x) for x in u_row],
+               "perms": [[int(i) for i in p] for p in perm_rows],
+               "qor": float(qor), "best": bool(is_best)}
+        self._archive_f.write(json.dumps(rec) + "\n")
+
+    def _flush_archive(self):
+        if self._archive_f is not None:
+            self._archive_f.flush()
+
+    # ------------------------------------------------------------------
+    def step(self) -> StepStats:
+        """One acquisition step: choose arm -> propose batch -> dedup ->
+        evaluate novel -> observe + credit."""
+        order = (self.root.select_order()
+                 if isinstance(self.root, MetaTechnique) else [self.root])
+        order = [t for t in order if t.name in self._tstates]
+
+        chosen = None
+        for t in order:
+            self.key, k = jax.random.split(self.key)
+            tstate, cands = self._propose_jit[t.name](
+                self._tstates[t.name], k, self.best)
+            hashes, found, known, src, novel = self._dedup(
+                self.hist_state, cands)
+            n_novel = int(novel.sum())
+            if n_novel > 0 or chosen is None:
+                chosen = (t, tstate, cands, hashes, found, known, src, novel,
+                          n_novel)
+            if n_novel > 0:
+                break
+        t, tstate, cands, hashes, found, known, src, novel, n_novel = chosen
+
+        injected = False
+        if n_novel == 0:
+            self._zero_novel_streak += 1
+            if self._zero_novel_streak >= 3:
+                # saturation fallback: random injection (the reference's
+                # space is never exhausted because SQL dedup just drops the
+                # DR and the driver retries; we top up explicitly).  The
+                # injected batch is NOT the arm's proposal: it must not
+                # flow into the arm's observe() or bandit credit.
+                injected = True
+                self.key, k = jax.random.split(self.key)
+                cands = self.space.random(k, cands.batch)
+                hashes, found, known, src, novel = self._dedup(
+                    self.hist_state, cands)
+                n_novel = int(novel.sum())
+        else:
+            self._zero_novel_streak = 0
+
+        novel_np = np.asarray(novel)
+        src_np = np.asarray(src)
+        qor_np = np.asarray(known, np.float32).copy()  # history dups served
+        evaluated = 0
+        if n_novel:
+            idx = np.nonzero(novel_np)[0]
+            sub = cands[jnp.asarray(idx)]
+            cfgs = self.space.to_configs(sub)
+            t0 = time.time()
+            vals = np.asarray(self.objective(cfgs), np.float64).reshape(-1)
+            dur = (time.time() - t0) / max(1, len(cfgs))
+            # engine minimizes; failures are +inf in ENGINE orientation
+            # (sign applies to valid values only, else sense='max' would
+            # turn a failure into an unbeatable -inf best)
+            qor_np[idx] = np.where(np.isfinite(vals), self.sign * vals,
+                                   np.inf)
+            evaluated = len(idx)
+            u_np = np.asarray(sub.u)
+            perms_np = [np.asarray(p) for p in sub.perms]
+            running = float(self.best.qor)
+            for j, cfg in enumerate(cfgs):
+                q_int = float(qor_np[idx[j]])
+                is_best = q_int < running
+                running = min(running, q_int)
+                self._log_trial(cfg, u_np[j], [p[j] for p in perms_np],
+                                self.sign * q_int, is_best, dur)
+                self.trace.append(self.sign * running)
+            self.evals += evaluated
+        # in-batch duplicates copy their source row's result
+        qor_np = qor_np[src_np]
+        qor = jnp.asarray(qor_np)
+
+        prev = float(self.best.qor)
+        self.hist_state, self.best = self._commit(
+            self.hist_state, self.best, hashes, cands, qor, novel)
+        new = float(self.best.qor)
+        was_new_best = new < prev
+        if not injected:
+            self._tstates[t.name] = self._observe_jit[t.name](
+                tstate, cands, qor, self.best)
+            if isinstance(self.root, MetaTechnique):
+                self.root.credit(t.name, was_new_best)
+        if self.evals > self.history.capacity and not self._cap_warned:
+            self._cap_warned = True
+            import warnings
+            warnings.warn(
+                f"evaluation count ({self.evals}) exceeded history capacity "
+                f"({self.history.capacity}); dedup will degrade — raise "
+                f"Tuner(capacity=...)")
+        self.steps += 1
+        self._flush_archive()
+        return StepStats(self.steps, "random" if injected else t.name,
+                         cands.batch, evaluated, self.sign * new,
+                         was_new_best)
+
+    # ------------------------------------------------------------------
+    def run(self, test_limit: int = 5000,
+            time_limit: Optional[float] = None,
+            target: Optional[float] = None) -> TuneResult:
+        """Run until `test_limit` evaluations (driver.py:25-26 default
+        5000), a wall-clock limit, or a target QoR is reached."""
+        t0 = time.time()
+        no_eval_streak = 0
+        while self.evals < test_limit:
+            stats = self.step()
+            no_eval_streak = 0 if stats.evaluated else no_eval_streak + 1
+            if no_eval_streak >= 25:
+                # search space exhausted: even random injection finds
+                # nothing hash-novel any more
+                break
+            if time_limit is not None and time.time() - t0 > time_limit:
+                break
+            if target is not None and self._target_met(target):
+                break
+        return self.result()
+
+    def _target_met(self, target: float) -> bool:
+        q = float(self.best.qor)
+        if not math.isfinite(q):
+            return False
+        user = self.sign * q
+        return user <= target if self.sense == "min" else user >= target
+
+    def result(self) -> TuneResult:
+        q = float(self.best.qor)
+        cfg = {}
+        if math.isfinite(q):
+            cfg = self.space.to_configs(self.best.as_batch(1))[0]
+        return TuneResult(cfg, self.sign * q, self.evals, self.steps,
+                          list(self.trace))
+
+    def best_config(self) -> Dict[str, Any]:
+        return self.result().best_config
+
+    def close(self):
+        if self._archive_f is not None:
+            self._archive_f.close()
+            self._archive_f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

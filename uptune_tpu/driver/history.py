@@ -1,0 +1,121 @@
+"""Device-resident evaluation history: dedup membership + QoR lookup.
+
+The reference dedups every proposal with an O(1-per-proposal) SQL hash
+lookup against a global SQLite table (`/root/reference/python/uptune/
+api.py:254-288`) and re-serves known results from it.  At 10^4-10^5
+candidates per acquisition step that structure is impossible; here the
+history is a pair of sorted uint32 hash arrays living on device, and both
+membership and known-QoR lookup are a single vectorized `searchsorted` +
+windowed compare over the whole candidate batch.
+
+Insertion is a merge: concatenate, lexicographic `lax.sort` on the two hash
+words, truncate to capacity.  Empty slots hold the (0xFFFFFFFF, 0xFFFFFFFF)
+sentinel so they sort to the end; real h0 values are clamped to
+0xFFFFFFFE.  All functions are pure and jittable with static shapes.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..space.spec import CandBatch, Space
+
+_SENTINEL = jnp.uint32(0xFFFFFFFF)
+# max number of equal-h0 neighbours scanned on lookup; h0 collisions of
+# distinct configs are ~n^2/2^33 over a run, so 8 is far beyond need
+_WINDOW = 8
+
+
+class HistState(NamedTuple):
+    h0: jax.Array    # [cap] uint32, sorted ascending (sentinel-padded)
+    h1: jax.Array    # [cap] uint32, lexicographic tie order with h0
+    qor: jax.Array   # [cap] f32, aligned with (h0, h1)
+    n: jax.Array     # scalar int32 count of live entries
+
+
+class History:
+    """Static config (capacity) + pure state transforms."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.capacity = int(capacity)
+
+    def init(self) -> HistState:
+        cap = self.capacity
+        return HistState(
+            jnp.full((cap,), _SENTINEL, jnp.uint32),
+            jnp.full((cap,), _SENTINEL, jnp.uint32),
+            jnp.full((cap,), jnp.inf, jnp.float32),
+            jnp.asarray(0, jnp.int32))
+
+    @staticmethod
+    def _clamp(hashes: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        h0 = jnp.minimum(hashes[:, 0].astype(jnp.uint32), _SENTINEL - 1)
+        h1 = hashes[:, 1].astype(jnp.uint32)
+        return h0, h1
+
+    def contains(self, st: HistState,
+                 hashes: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """hashes [B, 2] -> (found [B] bool, known_qor [B] f32 (+inf when
+        absent)).  The reference analogue is the `unique`/global-DB `get`
+        duplicate check (api.py:254-288, database/globalmodels.py:38-45)."""
+        h0, h1 = self._clamp(hashes)
+        idx = jnp.searchsorted(st.h0, h0, side="left")
+        found = jnp.zeros(h0.shape, bool)
+        qor = jnp.full(h0.shape, jnp.inf, jnp.float32)
+        cap = self.capacity
+        for j in range(_WINDOW):
+            pos = jnp.minimum(idx + j, cap - 1)
+            hit = (st.h0[pos] == h0) & (st.h1[pos] == h1) & ~found
+            qor = jnp.where(hit, st.qor[pos], qor)
+            found = found | hit
+        return found, qor
+
+    def insert(self, st: HistState, hashes: jax.Array, qor: jax.Array,
+               valid: jax.Array) -> HistState:
+        """Merge a batch of (hash, qor) rows where `valid` is True.
+        Overflow beyond capacity silently drops the largest hashes (the
+        driver warns host-side)."""
+        h0n, h1n = self._clamp(hashes)
+        h0n = jnp.where(valid, h0n, _SENTINEL)
+        h1n = jnp.where(valid, h1n, _SENTINEL)
+        h0c = jnp.concatenate([st.h0, h0n])
+        h1c = jnp.concatenate([st.h1, h1n])
+        qc = jnp.concatenate([st.qor, qor.astype(jnp.float32)])
+        h0s, h1s, qs = jax.lax.sort((h0c, h1c, qc), num_keys=2)
+        cap = self.capacity
+        n = jnp.minimum(st.n + valid.sum().astype(jnp.int32), cap)
+        return HistState(h0s[:cap], h1s[:cap], qs[:cap], n)
+
+
+def unique_mask(hashes: jax.Array) -> jax.Array:
+    """[B, 2] -> [B] bool marking the FIRST occurrence of each distinct
+    hash within the batch (in-batch dedup; stable, order-preserving)."""
+    h0 = hashes[:, 0].astype(jnp.uint32)
+    h1 = hashes[:, 1].astype(jnp.uint32)
+    order = jnp.arange(h0.shape[0], dtype=jnp.int32)
+    h0s, h1s, osort = jax.lax.sort((h0, h1, order), num_keys=3)
+    first_sorted = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (h0s[1:] != h0s[:-1]) | (h1s[1:] != h1s[:-1])])
+    mask = jnp.zeros(h0.shape, bool).at[osort].set(first_sorted)
+    return mask
+
+
+def dup_source(hashes: jax.Array) -> jax.Array:
+    """[B, 2] -> [B] int32: index of the first in-batch occurrence of each
+    row's hash (i for first occurrences themselves).  Lets the driver copy
+    one evaluation result onto all in-batch duplicates."""
+    h0 = hashes[:, 0].astype(jnp.uint32)
+    h1 = hashes[:, 1].astype(jnp.uint32)
+    order = jnp.arange(h0.shape[0], dtype=jnp.int32)
+    h0s, h1s, osort = jax.lax.sort((h0, h1, order), num_keys=3)
+    is_first = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (h0s[1:] != h0s[:-1]) | (h1s[1:] != h1s[:-1])])
+    # carry forward the original index of the head of each equal run
+    group_head = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_first, jnp.arange(h0.shape[0]), 0))
+    src_sorted = osort[group_head]
+    return jnp.zeros(h0.shape, jnp.int32).at[osort].set(src_sorted)

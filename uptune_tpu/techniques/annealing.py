@@ -20,7 +20,6 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..ops import perm as pops
 from ..space.spec import CandBatch, Space
 from .base import Best, Technique, register
 from .common import mutate_perm_random_op

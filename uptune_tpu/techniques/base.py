@@ -131,14 +131,19 @@ def get_technique(name: str) -> Technique:
 def get_root(names: Optional[Sequence[str]] = None) -> Technique:
     """Resolve --technique args to a root technique: default portfolio when
     none given, the single technique when one, a round-robin portfolio when
-    several (search/technique.py:345-362)."""
+    several (search/technique.py:345-362).
+
+    Returns a deep copy: registry entries are shared singletons, but
+    meta-techniques carry mutable host state (bandit credit window,
+    round-robin cursor) that must not leak between tuning runs."""
+    import copy
     _ensure_loaded()
     from .bandit import RoundRobinMeta  # circular-safe: bandit imports base
     if not names:
-        return _registry["AUCBanditMetaTechniqueA"]
+        return copy.deepcopy(_registry["AUCBanditMetaTechniqueA"])
     if len(names) == 1:
-        return get_technique(names[0])
-    return RoundRobinMeta([get_technique(n) for n in names],
+        return copy.deepcopy(get_technique(names[0]))
+    return RoundRobinMeta([copy.deepcopy(get_technique(n)) for n in names],
                           name="+".join(names))
 
 
@@ -150,11 +155,6 @@ def _ensure_loaded():
     global _loaded
     if _loaded:
         return
-    try:
-        from . import purerandom, de, evolutionary, pso, annealing  # noqa: F401
-        from . import pattern, simplex, bandit                      # noqa: F401
-    except Exception:
-        # leave _loaded False so the real import error resurfaces on the
-        # next call instead of an 'unknown technique' on a half registry
-        raise
+    from . import purerandom, de, evolutionary, pso, annealing  # noqa: F401
+    from . import pattern, simplex, bandit                      # noqa: F401
     _loaded = True

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..ops import numeric as nops
 from ..ops import perm as pops
+from ..space import params as P
 from ..space.spec import CandBatch, Space
 from .base import Best, Technique, register
 
@@ -65,7 +66,7 @@ class PSO(Technique):
             key, 4 + len(space.perm_sizes))
         have = jnp.isfinite(best.qor)
         gbest_u = jnp.where(have, best.u, state.pos.u[0])
-        bool_mask = (space.kind == 5)[None, :]  # P.BOOL
+        bool_mask = (space.kind == P.BOOL)[None, :]
         new_u, new_vel = nops.swarm(
             ks, state.pos.u, state.lbest.u, gbest_u[None, :], state.vel,
             space.complex_mask[None, :], bool_mask,

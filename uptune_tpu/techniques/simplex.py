@@ -102,14 +102,21 @@ class _SimplexBase(Technique):
         recycling behavior of MultiNelderMead/MultiTorczon
         (metatechniques.py:145-170) fused into the technique."""
         k1, k2, knext = jax.random.split(state.key, 3)
-        seed_u = jnp.where(jnp.isfinite(best.qor), best.u,
+        have_best = jnp.isfinite(best.qor)
+        seed_u = jnp.where(have_best, best.u,
                            jax.random.uniform(k2, best.u.shape))
         new_pts = self._initial_simplex(space, k1, seed_u)
         S = state.pts_u.shape[0]
+        # adopt the best's permutation blocks too — the reference's
+        # recycling re-creates the technique from the FULL best config
+        # (metatechniques.py:145-170), not only its scalar part
+        perms = tuple(
+            jnp.where(converged & have_best, bp, sp)
+            for sp, bp in zip(state.perms, best.perms))
         return SimplexState(
             jnp.where(converged, new_pts, state.pts_u),
             jnp.where(converged, jnp.full((S,), jnp.inf), state.vals),
-            state.perms,
+            perms,
             jnp.where(converged, INIT, LOOP).astype(jnp.int32),
             knext,
             jnp.where(converged, 0, state.stale).astype(jnp.int32))

@@ -1,0 +1,92 @@
+"""Synthetic benchmark objectives: the reference's own framework-test
+fixtures (`/root/reference/samples/rosenbrock/rosenbrock.py:1-60` functions
+rosenbrock / sphere / beale; `/root/reference/samples/tsp/tsp.py:1-19`
+permutation tour length), in batched form.
+
+Each objective provides:
+* `space(...)` -> a Space
+* a host callable `(list[config dict]) -> np.ndarray` for the Tuner
+* a pure-JAX `*_device(u_decoded or perm)` used by the fused on-device
+  engine and the bench harness.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..space.params import FloatParam, IntParam, PermParam
+from ..space.spec import Space
+
+
+# -- rosenbrock family ------------------------------------------------------
+def rosenbrock_space(dims: int = 2, lo: float = -30.0, hi: float = 30.0,
+                     as_int: bool = False) -> Space:
+    mk = IntParam if as_int else FloatParam
+    return Space([mk(f"x{i}", lo, hi) for i in range(dims)])
+
+
+def rosenbrock_device(x: jnp.ndarray) -> jnp.ndarray:
+    """[..., D] -> [...] classic Rosenbrock value."""
+    a, b = x[..., :-1], x[..., 1:]
+    return (100.0 * (b - a * a) ** 2 + (1.0 - a) ** 2).sum(axis=-1)
+
+
+def sphere_device(x: jnp.ndarray) -> jnp.ndarray:
+    return (x * x).sum(axis=-1)
+
+
+def beale_device(x: jnp.ndarray) -> jnp.ndarray:
+    a, b = x[..., 0], x[..., 1]
+    return ((1.5 - a + a * b) ** 2
+            + (2.25 - a + a * b ** 2) ** 2
+            + (2.625 - a + a * b ** 3) ** 2)
+
+
+def _configs_to_x(cfgs: List[Dict], dims: int) -> np.ndarray:
+    return np.asarray([[c[f"x{i}"] for i in range(dims)] for c in cfgs],
+                      np.float64)
+
+
+def make_host_objective(fn_device, dims: int):
+    def objective(cfgs: List[Dict]) -> np.ndarray:
+        x = _configs_to_x(cfgs, dims)
+        return np.asarray(fn_device(jnp.asarray(x)))
+    return objective
+
+
+def rosenbrock_objective(dims: int = 2):
+    return make_host_objective(rosenbrock_device, dims)
+
+
+# -- tsp --------------------------------------------------------------------
+def tsp_space(n_cities: int) -> Space:
+    return Space([PermParam("tour", list(range(n_cities)))])
+
+
+def random_tsp_distances(n_cities: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    pts = rng.rand(n_cities, 2)
+    d = np.sqrt(((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1))
+    return d
+
+
+def tsp_device(perm: jnp.ndarray, dist: jnp.ndarray) -> jnp.ndarray:
+    """perm [..., N] int32 city order -> [...] closed-tour length.
+
+    Deliberate variant: the reference scores the *open* path
+    (samples/tsp/tsp.py:8-13); we use the standard closed tour, whose
+    optimum is rotation-invariant — values are not directly comparable
+    to the reference's."""
+    nxt = jnp.roll(perm, -1, axis=-1)
+    return dist[perm, nxt].sum(axis=-1)
+
+
+def tsp_objective(dist: np.ndarray):
+    djnp = jnp.asarray(dist)
+
+    def objective(cfgs: List[Dict]) -> np.ndarray:
+        perm = jnp.asarray([c["tour"] for c in cfgs], jnp.int32)
+        return np.asarray(tsp_device(perm, djnp))
+    return objective
