@@ -1,0 +1,71 @@
+"""Headline benchmark: candidate acquisitions/sec/chip of the fused
+on-device tuning engine.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` is value / 100_000 — the north-star floor from
+BASELINE.json ("≥100k candidate acquisitions/sec on a v4-8"); the
+reference generates proposals sequentially, one config per technique call
+per instance (opentuner/search/driver.py:160-207), with per-proposal SQL
+dedup, so its own throughput is O(100/s) per CPU core.
+
+An acquisition here is the FULL per-candidate pipeline, not just RNG:
+propose (technique operator kernels) -> hash -> dedup vs a 2^15-entry
+history -> objective eval -> technique observe -> best update, all fused
+into one lax.scan program.
+
+Run on whatever platform JAX selects (TPU under the driver harness); pass
+--cpu to force the virtual CPU platform.
+"""
+import json
+import sys
+import time
+
+
+def main() -> None:
+    if "--cpu" in sys.argv:
+        sys.path.insert(0, "scripts")
+        import cpuenv  # noqa: F401
+    import jax
+
+    from uptune_tpu.engine import FusedEngine, default_arms
+    from uptune_tpu.workloads import rosenbrock_device, rosenbrock_space
+
+    # 16-D rosenbrock, arms scaled so each step acquires ~6k candidates:
+    # big enough to fill the chip, small enough that dedup history (2^15)
+    # holds several steps' worth
+    quick = "--quick" in sys.argv
+    space = rosenbrock_space(16, -5.0, 5.0)
+    eng = FusedEngine(space, lambda v, p: rosenbrock_device(v),
+                      arms=default_arms(scale=4 if quick else 64),
+                      history_capacity=1 << (12 if quick else 15))
+
+    steps = 20 if quick else 200
+    state = eng.init(jax.random.PRNGKey(0))
+    run = jax.jit(lambda s: eng.run(s, steps))
+    state = run(state)                      # compile + warm
+    jax.block_until_ready(state)
+
+    best_t = float("inf")
+    reps = 1 if quick else 3
+    for _ in range(reps):
+        s = eng.init(jax.random.PRNGKey(1))
+        jax.block_until_ready(s)
+        t0 = time.perf_counter()
+        s = run(s)
+        jax.block_until_ready(s)
+        best_t = min(best_t, time.perf_counter() - t0)
+
+    acqs = steps * eng.total_batch
+    rate = acqs / best_t
+    print(json.dumps({
+        "metric": "candidate_acquisitions_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "configs/s",
+        "vs_baseline": round(rate / 100_000.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -168,7 +168,9 @@ class TestArchiveResume:
         arc = str(tmp_path / "archive.jsonl")
         with Tuner(space, rosenbrock_objective(2), seed=1, archive=arc) as t:
             r1 = t.run(test_limit=300)
-        rows = [json.loads(l) for l in open(arc)]
+        lines = [json.loads(l) for l in open(arc)]
+        assert "space_sig" in lines[0]
+        rows = [r for r in lines if "space_sig" not in r]
         assert len(rows) == r1.evals
         assert {"gid", "time", "cfg", "u", "perms", "qor", "best"} <= set(rows[0])
         # resume: history pre-populated, best restored, evals counted
@@ -197,8 +199,24 @@ class TestArchiveResume:
         assert os.path.exists(arc + ".mismatch")
         t2.run(test_limit=20)
         t2.close()
-        rows = [json.loads(l) for l in open(arc)]
+        rows = [json.loads(l) for l in open(arc) if "cfg" in json.loads(l)]
         assert all(set(r["cfg"]) == {"y"} for r in rows)
+
+    def test_resume_rejects_reordered_params(self, tmp_path):
+        # same NAMES, different lane order: unit-vector replay would attach
+        # QoRs to transposed configs — must be treated as a mismatch
+        arc = str(tmp_path / "archive.jsonl")
+        s1 = Space([FloatParam("a", 0.0, 1.0), FloatParam("b", 0.0, 100.0)])
+
+        def obj(cfgs):
+            return [c["a"] + c["b"] for c in cfgs]
+
+        with Tuner(s1, obj, seed=0, archive=arc) as t:
+            t.run(test_limit=40)
+        s2 = Space([FloatParam("b", 0.0, 100.0), FloatParam("a", 0.0, 1.0)])
+        with pytest.warns(UserWarning, match="different space"):
+            t2 = Tuner(s2, obj, archive=arc, resume=True)
+        assert t2.evals == 0
 
     def test_resume_survives_torn_tail(self, tmp_path):
         arc = str(tmp_path / "archive.jsonl")
@@ -209,5 +227,12 @@ class TestArchiveResume:
             data = f.read()
         with open(arc, "w") as f:
             f.write(data[:-25])  # cut mid-record
-        t2 = Tuner(space, rosenbrock_objective(2), archive=arc, resume=True)
-        assert 0 < t2.evals < 60 + 40
+        with Tuner(space, rosenbrock_objective(2), archive=arc,
+                   resume=True) as t2:
+            assert 0 < t2.evals < 60 + 40
+            t2.run(test_limit=t2.evals + 40)
+        # the torn fragment was truncated before appending: every line in
+        # the archive must be valid JSON, so a THIRD resume loses nothing
+        lines = [json.loads(l) for l in open(arc)]
+        t3 = Tuner(space, rosenbrock_objective(2), archive=arc, resume=True)
+        assert t3.evals == len([r for r in lines if "cfg" in r])

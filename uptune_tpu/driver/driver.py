@@ -97,13 +97,13 @@ class Tuner:
         if root is None or isinstance(root, str) or (
                 isinstance(root, (list, tuple))):
             names = ([root] if isinstance(root, str) else root)
-            root = tbase.get_root(names)
-        # registry entries are shared singletons; meta-techniques carry
-        # mutable host-side bandit credit state, so each Tuner gets its own
-        # copy (the reference creates techniques fresh per tuning run)
-        import copy
-        self.root: Technique = copy.deepcopy(root)
-        root = self.root
+            root = tbase.get_root(names)  # returns a private copy
+        else:
+            # a directly-passed Technique may be shared by the caller;
+            # meta-techniques carry mutable host-side credit state
+            import copy
+            root = copy.deepcopy(root)
+        self.root: Technique = root
         members = (root.techniques if isinstance(root, MetaTechnique)
                    else [root])
         self.members: List[Technique] = [
@@ -146,34 +146,64 @@ class Tuner:
         if resume and archive and os.path.exists(archive):
             self._resume(archive)
         self._archive_f = open(archive, "a") if archive else None
+        if self._archive_f is not None and self._archive_f.tell() == 0:
+            # header: full space signature, checked on resume
+            self._archive_f.write(
+                json.dumps({"space_sig": self._space_sig()}) + "\n")
+            self._archive_f.flush()
 
     # ------------------------------------------------------------------
+    def _space_sig(self) -> List[str]:
+        """Ordered structural signature of the space: spec dataclass reprs
+        carry name, kind, bounds, options/items — any change invalidates
+        position-indexed unit-vector replay."""
+        return [repr(s) for s in self.space.specs]
+
     def _resume(self, path: str) -> None:
         """Replay the jsonl archive: exact unit vectors -> history + best
         (reference resume(), api.py:328-363 — replayed as technique 'seed',
         i.e. without touching technique states)."""
         rows = []
-        with open(path) as f:
+        sig = None
+        good_end = 0
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
             for line in f:
-                line = line.strip()
-                if line:
-                    try:
-                        rows.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        break  # torn tail write; ignore the rest
-        if not rows:
-            return
-        # column check: archive must match the current space; the reference
-        # deletes a mismatched archive (api.py:334-339) — we rotate it
-        # aside so mixed-space records never share one file
-        names = set(rows[0]["cfg"])
-        if names != {s.name for s in self.space.specs}:
+                text = line.strip()
+                if not text:
+                    good_end = f.tell()
+                    continue
+                try:
+                    rec = json.loads(text)
+                except json.JSONDecodeError:
+                    break  # torn tail write; ignore the rest
+                if not line.endswith(b"\n") and f.tell() == size:
+                    break  # complete JSON but unterminated final line
+                if "space_sig" in rec:
+                    sig = rec["space_sig"]
+                else:
+                    rows.append(rec)
+                good_end = f.tell()
+        if good_end < size:
+            # drop the torn fragment so the next append starts clean
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+        # the archive must match the current space STRUCTURALLY (order,
+        # kinds, bounds — raw unit vectors are position-indexed); the
+        # reference deletes a mismatched archive (api.py:334-339), we
+        # rotate it aside so mixed-space records never share one file
+        mismatch = (sig is not None and sig != self._space_sig()) or (
+            sig is None and rows
+            and set(rows[0]["cfg"]) != {s.name for s in self.space.specs})
+        if mismatch:
             import warnings
             bak = path + ".mismatch"
             os.replace(path, bak)
             warnings.warn(
-                f"archive {path} was recorded for a different space "
-                f"(params {sorted(names)}); moved aside to {bak}")
+                f"archive {path} was recorded for a different space; "
+                f"moved aside to {bak}")
+            return
+        if not rows:
             return
         B = len(rows)
         u = np.asarray([r["u"] for r in rows], np.float32)

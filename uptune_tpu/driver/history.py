@@ -92,15 +92,7 @@ class History:
 def unique_mask(hashes: jax.Array) -> jax.Array:
     """[B, 2] -> [B] bool marking the FIRST occurrence of each distinct
     hash within the batch (in-batch dedup; stable, order-preserving)."""
-    h0 = hashes[:, 0].astype(jnp.uint32)
-    h1 = hashes[:, 1].astype(jnp.uint32)
-    order = jnp.arange(h0.shape[0], dtype=jnp.int32)
-    h0s, h1s, osort = jax.lax.sort((h0, h1, order), num_keys=3)
-    first_sorted = jnp.concatenate([
-        jnp.ones((1,), bool),
-        (h0s[1:] != h0s[:-1]) | (h1s[1:] != h1s[:-1])])
-    mask = jnp.zeros(h0.shape, bool).at[osort].set(first_sorted)
-    return mask
+    return dup_source(hashes) == jnp.arange(hashes.shape[0])
 
 
 def dup_source(hashes: jax.Array) -> jax.Array:

@@ -1,0 +1,1 @@
+from .fused import DeviceObjective, EngineState, FusedEngine, default_arms  # noqa: F401

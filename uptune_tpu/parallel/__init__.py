@@ -1,0 +1,1 @@
+from .sharded import ShardedEngine, make_mesh  # noqa: F401
