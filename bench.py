@@ -25,7 +25,9 @@ import time
 
 def main() -> None:
     if "--cpu" in sys.argv:
-        sys.path.insert(0, "scripts")
+        import os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
         import cpuenv  # noqa: F401
     import jax
 

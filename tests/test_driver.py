@@ -202,6 +202,29 @@ class TestArchiveResume:
         rows = [json.loads(l) for l in open(arc) if "cfg" in json.loads(l)]
         assert all(set(r["cfg"]) == {"y"} for r in rows)
 
+    def test_non_resume_open_rotates_mismatched_archive(self, tmp_path):
+        # even WITHOUT resume=True, appending to another space's archive
+        # must rotate it aside, not mix records under the old header
+        import os
+        arc = str(tmp_path / "archive.jsonl")
+        space = rosenbrock_space(2, -3.0, 3.0)
+        with Tuner(space, rosenbrock_objective(2), seed=1, archive=arc) as t:
+            t.run(test_limit=40)
+        other = Space([FloatParam("y", 0.0, 1.0)])
+
+        def obj(cfgs):
+            return [c["y"] for c in cfgs]
+
+        with pytest.warns(UserWarning, match="different space"):
+            t2 = Tuner(other, obj, archive=arc)  # resume=False
+        t2.run(test_limit=20)
+        t2.close()
+        assert os.path.exists(arc + ".mismatch")
+        lines = [json.loads(l) for l in open(arc)]
+        assert all(set(r["cfg"]) == {"y"} for r in lines if "cfg" in r)
+        # and the new file got its own correct header
+        assert "space_sig" in lines[0]
+
     def test_resume_rejects_reordered_params(self, tmp_path):
         # same NAMES, different lane order: unit-vector replay would attach
         # QoRs to transposed configs — must be treated as a mismatch

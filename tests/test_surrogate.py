@@ -1,0 +1,129 @@
+"""Surrogate layer: GP + MLP-ensemble quality, acquisition functions, and
+multivoting prune integration with the Tuner."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uptune_tpu.driver import Tuner
+from uptune_tpu.space.params import FloatParam, PermParam
+from uptune_tpu.space.spec import Space
+from uptune_tpu.surrogate import SurrogateManager, gp, mlp
+from uptune_tpu.workloads import (rosenbrock_device, rosenbrock_objective,
+                                  rosenbrock_space)
+
+
+def _train_data(n=256, f=4, seed=0):
+    k = jax.random.PRNGKey(seed)
+    x = jax.random.uniform(k, (n, f))
+    y = ((x - 0.3) ** 2).sum(-1) + 0.05 * jnp.sin(10 * x[:, 0])
+    return x, y
+
+
+class TestGP:
+    def test_fit_predict_interpolates(self):
+        x, y = _train_data()
+        st = gp.fit(x, y)
+        mu, sd = gp.predict(st, x[:32])
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(y[:32]),
+                                   atol=0.1)
+        assert (np.asarray(sd) >= 0).all()
+
+    def test_rank_correlation_on_heldout(self):
+        x, y = _train_data(300)
+        st = gp.fit(x[:256], y[:256])
+        mu, _ = gp.predict(st, x[256:])
+        got, want = np.asarray(mu), np.asarray(y[256:])
+        # Spearman via rank correlation
+        r1 = np.argsort(np.argsort(got)).astype(float)
+        r2 = np.argsort(np.argsort(want)).astype(float)
+        rho = np.corrcoef(r1, r2)[0, 1]
+        assert rho > 0.8, rho
+
+    def test_ei_prefers_promising(self):
+        x, y = _train_data()
+        st = gp.fit(x, y)
+        good = jnp.full((1, 4), 0.3)   # near the optimum
+        bad = jnp.full((1, 4), 0.95)
+        ei = gp.expected_improvement(st, jnp.concatenate([good, bad]),
+                                     jnp.min(y))
+        assert float(ei[0]) >= float(ei[1])
+
+    def test_nonfinite_targets_clamped(self):
+        x, y = _train_data(64)
+        y = y.at[0].set(jnp.inf)
+        st = gp.fit(x, y)
+        mu, _ = gp.predict(st, x[:8])
+        assert np.isfinite(np.asarray(mu)).all()
+
+    def test_subsample_keeps_best(self):
+        x, y = _train_data(512)
+        xs, ys = gp.subsample(jax.random.PRNGKey(0), x, y, 128)
+        assert xs.shape == (128, 4)
+        assert float(ys.min()) == float(y.min())
+
+
+class TestMLP:
+    def test_ensemble_fit_and_disagreement(self):
+        x, y = _train_data(256)
+        st = mlp.fit(jax.random.PRNGKey(0), x, y, n_members=4, steps=200)
+        preds = mlp.predict_members(st, x[:64])
+        assert preds.shape == (4, 64)
+        mu, sd = mlp.predict(st, x[:64])
+        err = float(jnp.abs(mu - y[:64]).mean())
+        assert err < 0.3, err
+        assert float(sd.mean()) > 0
+
+
+class TestManager:
+    def _space(self):
+        return rosenbrock_space(2, -3.0, 3.0)
+
+    def test_not_fitted_below_min_points(self):
+        m = SurrogateManager(self._space(), "gp", min_points=64)
+        m.observe(np.random.rand(10, 2), np.random.rand(10))
+        assert not m.maybe_refit()
+        assert m.keep_mask(self._space().random(
+            jax.random.PRNGKey(0), 8)) is None
+
+    @pytest.mark.parametrize("kind", ["gp", "mlp"])
+    def test_prune_rejects_bad_keeps_good(self, kind):
+        space = self._space()
+        key = jax.random.PRNGKey(0)
+        cands = space.random(key, 512)
+        feats = np.asarray(space.features(cands))
+        qor = np.asarray(rosenbrock_device(space.decode_scalars(cands.u)))
+        m = SurrogateManager(space, kind, min_points=64, explore_frac=0.0,
+                             n_members=4)
+        m.observe(feats, qor)
+        assert m.maybe_refit()
+        probe = space.random(jax.random.PRNGKey(1), 256)
+        keep = m.keep_mask(probe)
+        pq = np.asarray(rosenbrock_device(space.decode_scalars(probe.u)))
+        assert keep is not None and 0 < keep.sum() < len(keep)
+        # kept candidates should be substantially better on average
+        assert pq[keep].mean() < pq[~keep].mean()
+
+    def test_explore_fraction_keeps_some(self):
+        space = self._space()
+        m = SurrogateManager(space, "gp", min_points=32, explore_frac=1.0)
+        cands = space.random(jax.random.PRNGKey(0), 128)
+        m.observe(np.asarray(space.features(cands)),
+                  np.random.rand(128))
+        m.maybe_refit()
+        keep = m.keep_mask(space.random(jax.random.PRNGKey(1), 64))
+        assert keep.all()  # explore_frac=1.0 keeps everything
+
+
+class TestTunerIntegration:
+    @pytest.mark.parametrize("kind", ["gp", "mlp"])
+    def test_tuner_with_surrogate_converges(self, kind):
+        space = rosenbrock_space(2, -3.0, 3.0)
+        t = Tuner(space, rosenbrock_objective(2), seed=3, surrogate=kind,
+                  surrogate_opts=dict(min_points=96, refit_interval=96,
+                                      n_members=3))
+        res = t.run(test_limit=900)
+        assert res.best_qor < 2.0, res.best_qor
+        assert t.pruned_total > 0, "surrogate never pruned anything"
+        # pruned candidates are not archived/evaluated
+        assert res.evals <= 900 + 200

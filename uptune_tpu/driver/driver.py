@@ -44,6 +44,7 @@ class StepStats(NamedTuple):
     evaluated: int
     best_qor: float
     was_new_best: bool
+    pruned: int = 0
 
 
 class TuneResult(NamedTuple):
@@ -75,7 +76,8 @@ class Tuner:
                  technique=None, seed: int = 0, sense: str = "min",
                  capacity: int = 1 << 16,
                  archive: Optional[str] = None,
-                 resume: bool = False):
+                 resume: bool = False,
+                 surrogate=None, surrogate_opts: Optional[dict] = None):
         assert sense in ("min", "max"), sense
         self.space = space
         self.objective = objective
@@ -92,6 +94,14 @@ class Tuner:
         self.trace: List[float] = []
         self._zero_novel_streak = 0
         self._cap_warned = False
+        self.pruned_total = 0
+
+        # surrogate-ensemble pruning (api.py:291-326 semantics)
+        if isinstance(surrogate, str):
+            from ..surrogate.manager import SurrogateManager
+            surrogate = SurrogateManager(
+                space, surrogate, seed=seed, **(surrogate_opts or {}))
+        self.surrogate = surrogate
 
         root = technique
         if root is None or isinstance(root, str) or (
@@ -145,9 +155,13 @@ class Tuner:
 
         if resume and archive and os.path.exists(archive):
             self._resume(archive)
+        elif archive and os.path.exists(archive) and os.path.getsize(archive):
+            # not resuming, but never append to a different space's file:
+            # check (or backfill) the signature header before reuse
+            self._check_archive_header(archive)
         self._archive_f = open(archive, "a") if archive else None
         if self._archive_f is not None and self._archive_f.tell() == 0:
-            # header: full space signature, checked on resume
+            # header: full space signature, checked on every reopen
             self._archive_f.write(
                 json.dumps({"space_sig": self._space_sig()}) + "\n")
             self._archive_f.flush()
@@ -158,6 +172,29 @@ class Tuner:
         carry name, kind, bounds, options/items — any change invalidates
         position-indexed unit-vector replay."""
         return [repr(s) for s in self.space.specs]
+
+    def _rotate_mismatch(self, path: str) -> None:
+        import warnings
+        bak = path + ".mismatch"
+        os.replace(path, bak)
+        warnings.warn(
+            f"archive {path} was recorded for a different space; "
+            f"moved aside to {bak}")
+
+    def _check_archive_header(self, path: str) -> None:
+        """Rotate the archive aside unless its signature (or, for legacy
+        headerless files, its first row's param-name set) matches."""
+        try:
+            with open(path) as f:
+                first = json.loads(f.readline())
+        except (json.JSONDecodeError, OSError):
+            return
+        if "space_sig" in first:
+            if first["space_sig"] != self._space_sig():
+                self._rotate_mismatch(path)
+        elif "cfg" in first and set(first["cfg"]) != {
+                s.name for s in self.space.specs}:
+            self._rotate_mismatch(path)
 
     def _resume(self, path: str) -> None:
         """Replay the jsonl archive: exact unit vectors -> history + best
@@ -196,12 +233,7 @@ class Tuner:
             sig is None and rows
             and set(rows[0]["cfg"]) != {s.name for s in self.space.specs})
         if mismatch:
-            import warnings
-            bak = path + ".mismatch"
-            os.replace(path, bak)
-            warnings.warn(
-                f"archive {path} was recorded for a different space; "
-                f"moved aside to {bak}")
+            self._rotate_mismatch(path)
             return
         if not rows:
             return
@@ -283,6 +315,20 @@ class Tuner:
         src_np = np.asarray(src)
         qor_np = np.asarray(known, np.float32).copy()  # history dups served
         evaluated = 0
+        pruned = 0
+        if n_novel and self.surrogate is not None and not injected:
+            keep = self.surrogate.keep_mask(cands)
+            if keep is not None:
+                pruned = int((novel_np & ~keep).sum())
+                if pruned:
+                    # rejected without evaluation (multivoting prune,
+                    # api.py:307-326): +inf to the technique, NOT archived,
+                    # NOT inserted into history (may be re-proposed and
+                    # re-judged after a refit)
+                    novel_np = novel_np & keep
+                    novel = jnp.asarray(novel_np)
+                    n_novel = int(novel_np.sum())
+                    self.pruned_total += pruned
         if n_novel:
             idx = np.nonzero(novel_np)[0]
             sub = cands[jnp.asarray(idx)]
@@ -307,6 +353,10 @@ class Tuner:
                                 self.sign * q_int, is_best, dur)
                 self.trace.append(self.sign * running)
             self.evals += evaluated
+            if self.surrogate is not None:
+                self.surrogate.observe(
+                    np.asarray(self.space.features(sub)), qor_np[idx])
+                self.surrogate.maybe_refit()
         # in-batch duplicates copy their source row's result
         qor_np = qor_np[src_np]
         qor = jnp.asarray(qor_np)
@@ -332,7 +382,7 @@ class Tuner:
         self._flush_archive()
         return StepStats(self.steps, "random" if injected else t.name,
                          cands.batch, evaluated, self.sign * new,
-                         was_new_best)
+                         was_new_best, pruned)
 
     # ------------------------------------------------------------------
     def run(self, test_limit: int = 5000,

@@ -73,6 +73,7 @@ class ShardedEngine:
             raise ValueError(
                 f"total batch {engine.total_batch} not divisible by "
                 f"eval-axis size {self.n_eval}")
+        self._compiled: dict = {}
 
     # -- state management ---------------------------------------------------
     def init(self, key: jax.Array) -> EngineState:
@@ -132,12 +133,19 @@ class ShardedEngine:
         return local_run
 
     def run(self, state: EngineState, n_steps: int) -> EngineState:
-        """n_steps sharded steps as one shard_map-ed scan program."""
-        fn = shard_map(
-            self._local(n_steps), mesh=self.mesh,
-            in_specs=(P("search"),), out_specs=P("search"),
-            check_rep=False)
-        return jax.jit(fn)(state)
+        """n_steps sharded steps as one shard_map-ed scan program.
+
+        The compiled program is memoized per n_steps — jax.jit caches by
+        function identity, so rebuilding the closure each call would
+        recompile the whole multi-replica program every invocation."""
+        fn = self._compiled.get(n_steps)
+        if fn is None:
+            fn = jax.jit(shard_map(
+                self._local(n_steps), mesh=self.mesh,
+                in_specs=(P("search"),), out_specs=P("search"),
+                check_rep=False))
+            self._compiled[n_steps] = fn
+        return fn(state)
 
     # -- host-side results --------------------------------------------------
     def best(self, state: EngineState) -> Tuple[dict, float]:

@@ -246,6 +246,24 @@ class Space:
         parts = [lanes] + [p.astype(jnp.int32) for p in cands.perms]
         return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else lanes
 
+    @property
+    def n_features(self) -> int:
+        return self.n_scalar + sum(self.perm_sizes)
+
+    def features(self, cands: CandBatch) -> jax.Array:
+        """[B, n_features] f32 surrogate-model features: scalar unit lanes
+        as-is; each permutation block contributes the normalized POSITION
+        of every item in the ordering (a fixed-width, smooth-ish embedding
+        of the permutation — the analogue of the reference's flat feature
+        vectors fed to XGBoost, plugins/xgbregressor.py:55,67)."""
+        parts = [cands.u]
+        for pm, size in zip(cands.perms, self.perm_sizes):
+            # position of item i in the ordering == inverse permutation
+            pos = jnp.argsort(pm, axis=-1).astype(jnp.float32) / max(
+                1, size - 1)
+            parts.append(pos)
+        return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+
     def hash_batch(self, cands: CandBatch) -> jax.Array:
         """[B] uint64-equivalent hash as a [B, 2] uint32 pair (multiply-shift
         universal hashing; device-side replacement for the reference's

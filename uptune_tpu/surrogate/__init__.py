@@ -1,0 +1,2 @@
+from . import gp, mlp  # noqa: F401
+from .manager import KINDS, SurrogateManager  # noqa: F401

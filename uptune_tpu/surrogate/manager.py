@@ -1,0 +1,114 @@
+"""Surrogate lifecycle + multivoting prune for the host driver.
+
+Mirrors the reference's controller-side surrogate plumbing: offline init
+from training data + online re-fit cadence (`/root/reference/python/uptune/
+api.py:291-304`, `src/multi_stage.py:157-162`) and the `multivoting`
+proposal filter (`api.py:307-326`: each ensemble member votes on every
+candidate; losers are dropped before evaluation).
+
+Votes here: a member votes FOR a candidate when its predicted QoR lands in
+the best `keep_quantile` of observed history.  A candidate survives with
+>= `majority` of votes, and an `explore_frac` random share of the batch
+always survives (the reference's random-pick-outside-top-split serves the
+same anti-myopia role, multi_stage.py:109-117).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..space.spec import CandBatch, Space
+from . import gp as gp_mod
+from . import mlp as mlp_mod
+
+KINDS = ("gp", "mlp")
+
+
+class SurrogateManager:
+    def __init__(self, space: Space, kind: str = "gp", *,
+                 min_points: int = 64, refit_interval: int = 64,
+                 keep_quantile: float = 0.5, majority: float = 0.5,
+                 explore_frac: float = 0.1, max_points: int = 1024,
+                 n_members: int = 4, seed: int = 0):
+        if kind not in KINDS:
+            raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
+        self.space = space
+        self.kind = kind
+        self.min_points = min_points
+        self.refit_interval = refit_interval
+        self.keep_quantile = keep_quantile
+        self.majority = majority
+        self.explore_frac = explore_frac
+        self.max_points = max_points
+        self.n_members = n_members
+        self._xs: list = []
+        self._ys: list = []
+        self._state = None
+        self._since_fit = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._threshold = None
+
+        if kind == "gp":
+            self._fit = jax.jit(gp_mod.fit)
+            self._score = jax.jit(gp_mod.lower_confidence_bound)
+        else:
+            self._fit = jax.jit(
+                lambda k, x, y: mlp_mod.fit(k, x, y, n_members=n_members))
+            self._score = jax.jit(mlp_mod.predict_members)
+
+    # ------------------------------------------------------------------
+    @property
+    def n_points(self) -> int:
+        return len(self._ys)
+
+    @property
+    def fitted(self) -> bool:
+        return self._state is not None
+
+    def observe(self, feats: np.ndarray, qor: np.ndarray) -> None:
+        """Record evaluated (features, engine-oriented QoR) rows."""
+        for f, q in zip(np.asarray(feats), np.asarray(qor)):
+            self._xs.append(np.asarray(f, np.float32))
+            self._ys.append(float(q))
+            self._since_fit += 1
+
+    def maybe_refit(self) -> bool:
+        if self.n_points < self.min_points:
+            return False
+        if self.fitted and self._since_fit < self.refit_interval:
+            return False
+        x = jnp.asarray(np.stack(self._xs))
+        y = jnp.asarray(np.asarray(self._ys, np.float32))
+        self._key, ks, kf = jax.random.split(self._key, 3)
+        x, y = gp_mod.subsample(ks, x, y, self.max_points)
+        if self.kind == "gp":
+            self._state = self._fit(x, y)
+        else:
+            self._state = self._fit(kf, x, y)
+        finite = [v for v in self._ys if np.isfinite(v)]
+        self._threshold = float(
+            np.quantile(finite, self.keep_quantile)) if finite else None
+        self._since_fit = 0
+        return True
+
+    # ------------------------------------------------------------------
+    def keep_mask(self, cands: CandBatch) -> Optional[np.ndarray]:
+        """[B] bool host mask: True = evaluate. None when not fitted."""
+        if not self.fitted or self._threshold is None:
+            return None
+        feats = self.space.features(cands)
+        if self.kind == "gp":
+            lcb = np.asarray(self._score(self._state, feats))
+            keep = lcb <= self._threshold
+        else:
+            preds = np.asarray(self._score(self._state, feats))  # [E, B]
+            votes = (preds <= self._threshold).mean(axis=0)
+            keep = votes >= self.majority
+        b = keep.shape[0]
+        self._key, ke = jax.random.split(self._key)
+        explore = np.asarray(
+            jax.random.uniform(ke, (b,))) < self.explore_frac
+        return keep | explore

@@ -1,0 +1,97 @@
+"""MLP-ensemble surrogate: E independently-initialized regressors trained
+in parallel with vmap — the JAX counterpart of the reference's surrogate
+*ensemble* (`/root/reference/python/uptune/plugins/models.py:54-72`
+discovers N model plugins and averages their scores; here the ensemble is
+one vmapped train/predict program and disagreement across members doubles
+as an uncertainty signal for multivoting pruning, api.py:307-326).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class MLPEnsembleState(NamedTuple):
+    params: Tuple       # pytree with leading ensemble axis [E, ...]
+    x_mean: jax.Array   # [F]
+    x_std: jax.Array    # [F]
+    y_mean: jax.Array
+    y_std: jax.Array
+
+
+def _init_params(key: jax.Array, sizes) -> Tuple:
+    params = []
+    for din, dout in zip(sizes[:-1], sizes[1:]):
+        key, kw = jax.random.split(key)
+        w = jax.random.normal(kw, (din, dout)) * jnp.sqrt(2.0 / din)
+        params.append((w, jnp.zeros((dout,))))
+    return tuple(params)
+
+
+def _forward(params, x):
+    for i, (w, b) in enumerate(params):
+        x = x @ w + b
+        if i < len(params) - 1:
+            x = jax.nn.gelu(x)
+    return x[..., 0]
+
+
+def fit(key: jax.Array, x: jax.Array, y: jax.Array, n_members: int = 4,
+        width: int = 64, steps: int = 300,
+        lr: float = 3e-3) -> MLPEnsembleState:
+    """Train the whole ensemble with vmapped full-batch Adam."""
+    finite = jnp.isfinite(y)
+    worst = jnp.max(jnp.where(finite, y, -jnp.inf))
+    y = jnp.where(finite, y, worst)
+    x_mean, x_std = x.mean(0), jnp.maximum(x.std(0), 1e-8)
+    y_mean, y_std = y.mean(), jnp.maximum(y.std(), 1e-8)
+    xn = (x - x_mean) / x_std
+    yn = (y - y_mean) / y_std
+    sizes = (x.shape[1], width, width, 1)
+
+    def train_one(k):
+        params = _init_params(k, sizes)
+        # inline Adam (no optax dependency in the hot path)
+        m = jax.tree.map(jnp.zeros_like, params)
+        v = jax.tree.map(jnp.zeros_like, params)
+
+        def loss_fn(p):
+            pred = _forward(p, xn)
+            return jnp.mean((pred - yn) ** 2)
+
+        def body(carry, i):
+            params, m, v = carry
+            g = jax.grad(loss_fn)(params)
+            m = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+            v = jax.tree.map(lambda a, b: 0.999 * a + 0.001 * b * b, v, g)
+            t = i + 1
+            mh = jax.tree.map(lambda a: a / (1 - 0.9 ** t), m)
+            vh = jax.tree.map(lambda a: a / (1 - 0.999 ** t), v)
+            params = jax.tree.map(
+                lambda p, a, b: p - lr * a / (jnp.sqrt(b) + 1e-8),
+                params, mh, vh)
+            return (params, m, v), None
+
+        (params, _, _), _ = jax.lax.scan(
+            body, (params, m, v), jnp.arange(steps))
+        return params
+
+    params = jax.vmap(train_one)(jax.random.split(key, n_members))
+    return MLPEnsembleState(params, x_mean, x_std, y_mean, y_std)
+
+
+def predict_members(state: MLPEnsembleState,
+                    xq: jax.Array) -> jax.Array:
+    """[B, F] -> [E, B] per-member predictions in original units."""
+    xn = (xq - state.x_mean) / state.x_std
+    preds = jax.vmap(lambda p: _forward(p, xn))(state.params)
+    return preds * state.y_std + state.y_mean
+
+
+def predict(state: MLPEnsembleState,
+            xq: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """[B, F] -> (mean [B], std-across-members [B])."""
+    preds = predict_members(state, xq)
+    return preds.mean(0), preds.std(0)
