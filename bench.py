@@ -2,7 +2,8 @@
 on-device tuning engine.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "platform": "tpu"|"cpu"}
 
 `vs_baseline` is value / 100_000 — the north-star floor from
 BASELINE.json ("≥100k candidate acquisitions/sec on a v4-8"); the
@@ -15,21 +16,75 @@ propose (technique operator kernels) -> hash -> dedup vs a 2^15-entry
 history -> objective eval -> technique observe -> best update, all fused
 into one lax.scan program.
 
-Run on whatever platform JAX selects (TPU under the driver harness); pass
---cpu to force the virtual CPU platform.
+Backend selection is defensive: the TPU tunnel on this machine can be
+wedged (BENCH_r01 failed with "Unable to initialize backend 'axon'"), so
+we probe the backend with a bounded retry and fall back to CPU with an
+explicit `platform: "cpu"` label — a CPU number can never masquerade as
+the TPU number.  Pass --cpu to force the virtual CPU platform.
 """
 import json
+import os
 import sys
 import time
 
 
-def main() -> None:
-    if "--cpu" in sys.argv:
-        import os
-        sys.path.insert(0, os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "scripts"))
-        import cpuenv  # noqa: F401
+def _probe_accelerator(timeout_s: float = 90.0) -> str:
+    """Check in a SUBPROCESS whether the accelerator backend initializes.
+
+    A wedged TPU tunnel makes jax.devices() hang (not raise) — exactly
+    what killed BENCH_r01 — so the probe must be killable.  Returns the
+    platform name on success, '' on failure/timeout.
+    """
+    import subprocess
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('UT_PLATFORM=' + d.platform)")
+    for attempt in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=timeout_s)
+            for line in out.stdout.splitlines():
+                if line.startswith("UT_PLATFORM="):
+                    plat = line.split("=", 1)[1].strip()
+                    if plat and plat != "cpu":
+                        return plat
+            print(f"bench: probe attempt {attempt + 1} got no accelerator "
+                  f"(rc={out.returncode}): {out.stderr.strip()[-300:]}",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench: probe attempt {attempt + 1} hung "
+                  f">{timeout_s:.0f}s (wedged TPU tunnel?)",
+                  file=sys.stderr)
+        time.sleep(2.0)
+    return ""
+
+
+def _init_backend(cpu_flag: bool):
+    """Import jax and return (jax, platform_name).  Never hangs: the
+    accelerator is probed in a killable subprocess first; on failure we
+    fall back to CPU with an explicit label."""
+    from uptune_tpu.utils.platform_guard import force_cpu
+
+    if cpu_flag:
+        force_cpu(8)
+        import jax
+        return jax, "cpu"
+
+    plat = _probe_accelerator()
+    if plat:
+        import jax
+        return jax, jax.devices()[0].platform
+    print("bench: accelerator unavailable; falling back to CPU — result "
+          "is labeled platform=cpu:fallback and does NOT stand in for "
+          "the TPU number", file=sys.stderr)
+    force_cpu(1)
     import jax
+    return jax, "cpu:fallback"
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv
+    jax, platform = _init_backend(cpu_flag="--cpu" in sys.argv)
 
     from uptune_tpu.engine import FusedEngine, default_arms
     from uptune_tpu.workloads import rosenbrock_device, rosenbrock_space
@@ -37,7 +92,6 @@ def main() -> None:
     # 16-D rosenbrock, arms scaled so each step acquires ~6k candidates:
     # big enough to fill the chip, small enough that dedup history (2^15)
     # holds several steps' worth
-    quick = "--quick" in sys.argv
     space = rosenbrock_space(16, -5.0, 5.0)
     eng = FusedEngine(space, lambda v, p: rosenbrock_device(v),
                       arms=default_arms(scale=4 if quick else 64),
@@ -66,6 +120,7 @@ def main() -> None:
         "value": round(rate, 1),
         "unit": "configs/s",
         "vs_baseline": round(rate / 100_000.0, 3),
+        "platform": platform,
     }))
 
 

@@ -1,17 +1,12 @@
-"""Shared preamble for ad-hoc CPU-only scripts (mirrors tests/conftest.py):
-force the virtual 8-device CPU platform and drop the axon TPU-tunnel
-backend factory before any JAX backend initializes."""
+"""Shared preamble for ad-hoc CPU-only scripts (same guard as
+tests/conftest.py): force the virtual 8-device CPU platform and drop the
+axon TPU-tunnel backend factory before any JAX backend initializes."""
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
+from uptune_tpu.utils.platform_guard import force_cpu  # noqa: E402
 
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_threefry_partitionable", True)
+force_cpu(8)

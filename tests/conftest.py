@@ -1,26 +1,14 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
 sharding paths are exercised without TPU hardware (the driver separately
-dry-runs `__graft_entry__.dryrun_multichip`)."""
+dry-runs `__graft_entry__.dryrun_multichip`).  The guard also drops the
+axon TPU-tunnel backend factory, which otherwise dials a (possibly
+wedged) tunnel during backends() initialization and hangs the suite."""
 import os
+import sys
 
-# Force, not setdefault: the machine environment pre-sets the experimental
-# axon TPU-tunnel platform, which must never be touched from the test suite.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from uptune_tpu.utils.platform_guard import force_cpu  # noqa: E402
 
-# The axon plugin (injected via sitecustomize on this image) registers a
-# backend factory whose PJRT client dials a TPU tunnel during backends()
-# initialization — even under JAX_PLATFORMS=cpu — and hangs the whole
-# suite if the tunnel is wedged.  Drop the factory before any backend is
-# initialized; tests are CPU-only by design.
-from jax._src import xla_bridge as _xb  # noqa: E402
-
-_xb._backend_factories.pop("axon", None)
-jax.config.update("jax_platforms", "cpu")
-
-jax.config.update("jax_threefry_partitionable", True)
+force_cpu(8)

@@ -1,0 +1,241 @@
+"""End-to-end tests of the intrusive API protocol: DEFAULT / ANALYSIS /
+TUNE / BEST modes, ut.target flush + breakpoints, session best round
+trip, and the constraint registry.
+
+Spec: /root/reference/python/uptune/template/types.py:57-138,
+report.py:45-103, api.py:52-65.
+"""
+import json
+import os
+
+import pytest
+
+import uptune_tpu as ut
+from uptune_tpu.api import constraint as C
+from uptune_tpu.api import session
+from uptune_tpu.api.state import STATE
+
+MODE_VARS = ("UT_BEFORE_RUN_PROFILE", "UT_TUNE_START", "BEST", "UPTUNE",
+             "UT_CURR_INDEX", "UT_CURR_STAGE", "UT_GLOBAL_ID",
+             "UT_WORK_DIR", "UT_MULTI_STAGE_SAMPLE", "EZTUNING")
+
+
+@pytest.fixture(autouse=True)
+def clean_env(tmp_path, monkeypatch):
+    for v in MODE_VARS:
+        monkeypatch.delenv(v, raising=False)
+    monkeypatch.setenv("UT_WORK_DIR", str(tmp_path))
+    C.REGISTRY.clear()
+    session.reset_settings()
+    STATE.reset()
+    yield tmp_path
+    STATE.reset()
+
+
+def _script(x_default=3):
+    """A reference-style tuned program body; returns (x, y, flag)."""
+    x = ut.tune(x_default, (1, 9), name="x")
+    y = ut.tune(0.5, (0.0, 2.0))          # unnamed -> positional binding
+    flag = ut.tune(True)
+    return x, y, flag
+
+
+def test_default_mode_returns_defaults():
+    assert _script() == (3, 0.5, True)
+
+
+def test_analysis_flushes_params_and_default_qor(clean_env, monkeypatch):
+    monkeypatch.setenv("UT_BEFORE_RUN_PROFILE", "On")
+    STATE.reset()
+    x, y, flag = _script()
+    assert (x, y, flag) == (3, 0.5, True)
+    ut.target(x + y, "min")
+    params = json.load(open(clean_env / "ut.params.json"))
+    assert len(params) == 1 and len(params[0]) == 3
+    assert params[0][0]["name"] == "x" and params[0][0]["type"] == "int"
+    assert params[0][1]["type"] == "float"
+    assert params[0][2]["type"] == "bool"
+    dq = json.load(open(clean_env / "ut.default_qor.json"))
+    assert dq["qor"] == 3.5 and dq["trend"] == "min"
+
+
+def _write_protocol_files(work, cfg, params=None):
+    os.makedirs(work / "configs", exist_ok=True)
+    with open(work / "configs" / "ut.dr_stage0_index0.json", "w") as f:
+        json.dump(cfg, f)
+    if params is not None:
+        with open(work / "ut.params.json", "w") as f:
+            json.dump(params, f)
+
+
+def test_tune_mode_serves_proposal_by_name_and_position(
+        clean_env, monkeypatch):
+    params = [[{"name": "x", "type": "int", "default": 3, "lo": 1, "hi": 9},
+               {"name": "v0_1", "type": "float", "default": 0.5,
+                "lo": 0.0, "hi": 2.0},
+               {"name": "v0_2", "type": "bool", "default": True}]]
+    _write_protocol_files(
+        clean_env, {"x": 7, "v0_1": 1.25, "v0_2": False}, params)
+    monkeypatch.setenv("UT_TUNE_START", "True")
+    monkeypatch.setenv("UT_CURR_INDEX", "0")
+    STATE.reset()
+    assert _script() == (7, 1.25, False)
+    ut.target(1.0, "min")
+    rows = json.load(open(clean_env / "ut.qor_stage0.json"))
+    assert rows == [[0, 1.0, "min"]]
+
+
+def test_tune_mode_missing_proposal_falls_back_to_defaults(
+        clean_env, monkeypatch):
+    monkeypatch.setenv("UT_TUNE_START", "True")
+    STATE.reset()
+    assert _script() == (3, 0.5, True)
+
+
+def test_best_mode_applies_best_with_positional_binding(
+        clean_env, monkeypatch):
+    session.write_best({"x": 9, "v0_1": 1.75, "v0_2": False}, 0.125,
+                       work_dir=str(clean_env))
+    with open(clean_env / "ut.params.json", "w") as f:
+        json.dump([[{"name": "x"}, {"name": "v0_1"}, {"name": "v0_2"}]], f)
+    monkeypatch.setenv("BEST", "True")
+    STATE.reset()
+    # unnamed calls must bind positionally in BEST mode too (ADVICE r1)
+    assert _script() == (9, 1.75, False)
+    cfg, qor = ut.get_best()
+    assert cfg["x"] == 9 and qor == 0.125
+
+
+def test_init_apply_best_switches_mode(clean_env, monkeypatch):
+    session.write_best({"x": 4}, 1.0, work_dir=str(clean_env))
+    ut.init(apply_best=True)
+    assert os.environ["UPTUNE"] == "True"
+    assert STATE.mode == "best"
+    assert ut.tune(3, (1, 9), name="x") == 4
+
+
+def test_multistage_analysis_two_targets(clean_env, monkeypatch):
+    monkeypatch.setenv("UT_BEFORE_RUN_PROFILE", "On")
+    STATE.reset()
+    ut.tune(3, (1, 9), name="a")
+    ut.target(1.0, "min")             # stage 0 boundary
+    ut.tune(0.5, (0.0, 1.0), name="b")
+    ut.target(2.0, "min")             # stage 1 boundary
+    params = json.load(open(clean_env / "ut.params.json"))
+    assert len(params) == 2
+    assert params[0][0]["name"] == "a" and params[1][0]["name"] == "b"
+
+
+def test_multistage_tune_breakpoint_exits(clean_env, monkeypatch):
+    params = [[{"name": "a", "type": "int", "default": 3, "lo": 1,
+                "hi": 9}],
+              [{"name": "b", "type": "float", "default": 0.5, "lo": 0.0,
+                "hi": 1.0}]]
+    _write_protocol_files(clean_env, {"a": 5}, params)
+    monkeypatch.setenv("UT_TUNE_START", "True")
+    monkeypatch.setenv("UT_CURR_STAGE", "0")
+    STATE.reset()
+    assert ut.tune(3, (1, 9), name="a") == 5
+    with pytest.raises(SystemExit):
+        ut.target(1.5, "min")         # tuned stage -> write + exit
+    rows = json.load(open(clean_env / "ut.qor_stage0.json"))
+    assert rows == [[0, 1.5, "min"]]
+
+
+def test_save_decorator_reports_qor(clean_env, monkeypatch):
+    monkeypatch.setenv("UT_TUNE_START", "True")
+    STATE.reset()
+
+    @ut.save("max")
+    def objective():
+        return 42.0
+
+    assert objective() == 42.0
+    rows = json.load(open(clean_env / "ut.qor_stage0.json"))
+    assert rows == [[0, 42.0, "max"]]
+
+
+def test_feature_and_register(clean_env, monkeypatch):
+    monkeypatch.setenv("UT_BEFORE_RUN_PROFILE", "On")
+    STATE.reset()
+    ut.feature(8, "cores")
+    covars = json.load(open(clean_env / "covars.json"))
+    assert covars == {"cores": 8}
+    assert int(ut.vars.cores) == 8
+    # VarNode usable as a tune() bound
+    assert ut.tune(5, (2, int(ut.vars.cores))) == 5
+
+
+def test_rules_and_constraints_enforced():
+    @ut.rule()
+    def no_both(cfg):
+        return not (cfg["a"] and cfg["b"])
+
+    @ut.constraint()
+    def sane(qor, cfg):
+        return qor < 100
+
+    assert C.REGISTRY.check_config({"a": True, "b": False})
+    assert not C.REGISTRY.check_config({"a": True, "b": True})
+    assert C.REGISTRY.check_qor(5.0, {})
+    assert not C.REGISTRY.check_qor(500.0, {})
+
+
+def test_config_validation():
+    s = ut.config({"test-limit": 50})
+    assert s["test-limit"] == 50
+    with pytest.raises(KeyError):
+        ut.config({"bogus": 1})
+
+
+def test_every_declared_export_resolves():
+    import uptune_tpu
+    for name in uptune_tpu._LAZY:
+        assert getattr(uptune_tpu, name) is not None
+
+
+def test_best_mode_accepts_reference_list_payload(clean_env, monkeypatch):
+    # the reference writes best.json as [cfg, qor] (api.py:146-149)
+    with open(clean_env / "best.json", "w") as f:
+        json.dump([{"x": 6}, 0.5], f)
+    monkeypatch.setenv("BEST", "True")
+    STATE.reset()
+    assert ut.tune(3, (1, 9), name="x") == 6
+
+
+def test_best_mode_malformed_payload_falls_back(clean_env, monkeypatch):
+    with open(clean_env / "best.json", "w") as f:
+        json.dump("garbage", f)
+    monkeypatch.setenv("BEST", "True")
+    STATE.reset()
+    assert ut.tune(3, (1, 9), name="x") == 3
+
+
+def test_feature_registers_vars_in_tune_mode(clean_env, monkeypatch):
+    monkeypatch.setenv("UT_TUNE_START", "True")
+    STATE.reset()
+    ut.feature(16, "cores")
+    assert int(ut.vars.cores) == 16  # bound must resolve during trials
+
+
+def test_best_mode_multistage_positional_binding(clean_env, monkeypatch):
+    # unnamed params in stage >= 1 must bind after target() advances the
+    # stage counter in BEST mode
+    session.write_best({"a": 5, "v1_0": 0.75}, 1.0,
+                       work_dir=str(clean_env))
+    with open(clean_env / "ut.params.json", "w") as f:
+        json.dump([[{"name": "a"}], [{"name": "v1_0"}]], f)
+    monkeypatch.setenv("BEST", "True")
+    STATE.reset()
+    assert ut.tune(3, (1, 9)) == 5        # stage 0, positional
+    ut.target(1.0, "min")                 # stage boundary
+    assert ut.tune(0.5, (0.0, 1.0)) == 0.75  # stage 1, positional
+
+
+def test_interm_writes_marker_and_features(clean_env, monkeypatch):
+    monkeypatch.setenv("UT_BEFORE_RUN_PROFILE", "On")
+    STATE.reset()
+    ut.interm([1.0, 2.0], shape=2)
+    assert (clean_env / "ut.interim_features.json").exists()
+    feats = json.load(open(clean_env / "ut.features.json"))
+    assert feats == [[-1, [1.0, 2.0]]]

@@ -34,6 +34,8 @@ _LAZY = {
     "constraint": ("uptune_tpu.api.constraint", "constraint"),
     "register": ("uptune_tpu.api.constraint", "register"),
     "vars": ("uptune_tpu.api.constraint", "vars"),
+    "model": ("uptune_tpu.api.tuner", "model"),
+    "settings": ("uptune_tpu.api.session", "settings"),
 }
 
 

@@ -1,0 +1,3 @@
+"""User-facing API layer: the intrusive tune/target protocol
+(`tuneapi`, `report`, `state`), session settings (`session`), and the
+constraint/covariate registry (`constraint`)."""

@@ -1,0 +1,94 @@
+"""Session settings + best-config persistence: `ut.config`, `ut.init`,
+`ut.get_best`.
+
+Mirrors the reference's validated settings dict
+(`/root/reference/python/uptune/__init__.py:45-55,79-83`) and best-config
+round trip (`api.py:52-65,146-149`): the controller writes ``best.json``
+on every improvement; ``get_best()`` reads it back; ``init(apply_best=
+True)`` switches the process into BEST mode so subsequent ``ut.tune()``
+calls serve the best config.
+
+Precedence contract (tests/python/test_async_execute.py:5-14 in the
+reference): CLI flags > ``ut.config(...)`` > these defaults.  The CLI
+layer (`uptune_tpu.cli`) reads this dict for any flag the user did not
+pass explicitly.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from .state import BEST_FILE, STATE
+
+DEFAULTS: Dict[str, Any] = {
+    "test-limit": 10,
+    "runtime-limit": 7200,
+    "timeout": 72000,
+    "parallel-factor": 2,
+    "async-interval": 0.05,
+    "gpu-num": 0,
+    "cpu-num": 1,
+    "learning-model": [],
+    "training-data": None,
+    "online-training": False,
+    "technique": None,
+    "seed": 0,
+}
+
+settings: Dict[str, Any] = dict(DEFAULTS)
+
+
+def config(user: Dict[str, Any]) -> Dict[str, Any]:
+    """Override session settings; unknown keys are rejected."""
+    if not isinstance(user, dict):
+        raise TypeError(f"config expects a dict, got {type(user).__name__}")
+    unknown = sorted(set(user) - set(DEFAULTS))
+    if unknown:
+        raise KeyError(
+            f"unknown setting(s) {unknown}; valid: {sorted(DEFAULTS)}")
+    settings.update(user)
+    return settings
+
+
+def reset_settings() -> None:
+    """Restore defaults (used by tests and between CLI runs)."""
+    settings.clear()
+    settings.update(DEFAULTS)
+
+
+def init(apply_best: bool = False) -> None:
+    """Mark the process as running under uptune; optionally apply the
+    best known config to subsequent ut.tune() calls."""
+    if os.environ.get("EZTUNING"):
+        return
+    os.environ["UPTUNE"] = "True"
+    if apply_best:
+        os.environ["BEST"] = "True"
+        STATE.reset()
+
+
+def best_path(work_dir: Optional[str] = None) -> str:
+    return os.path.join(work_dir or STATE.work_dir, BEST_FILE)
+
+
+def get_best(work_dir: Optional[str] = None) -> Tuple[Dict[str, Any], Any]:
+    """-> (best config dict, its QoR)."""
+    path = best_path(work_dir)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no best config at {path}: run a tuning session first")
+    with open(path) as f:
+        best = json.load(f)
+    if isinstance(best, dict) and "config" in best:
+        return best["config"], best.get("qor")
+    if isinstance(best, (list, tuple)) and len(best) == 2:
+        return dict(best[0]), best[1]
+    raise ValueError(f"unrecognized best.json payload at {path}")
+
+
+def write_best(cfg: Dict[str, Any], qor: Any,
+               work_dir: Optional[str] = None) -> None:
+    """Controller-side write of best.json (api.py:146-149)."""
+    with open(best_path(work_dir), "w") as f:
+        json.dump({"config": cfg, "qor": qor}, f, indent=1)

@@ -19,6 +19,13 @@ Proposal lookup is by the reference's order-dependent positional counter
 parameter.  The controller additionally publishes a name-keyed map, and we
 look up by *name first*, falling back to position — robust when names are
 given, compatible when not.
+
+Deliberate divergences from the reference protocol (the controller in
+`uptune_tpu.exec` is written against THIS contract):
+  * work dir env var is ``UT_WORK_DIR`` (reference: ``UT_TEMP_DIR``,
+    api.py:94) — one variable for both roles.
+  * proposal files are ``configs/ut.dr_stage{S}_index{I}.json``
+    (reference: ``configs/{stage}-{index}.json``) — self-describing names.
 """
 from __future__ import annotations
 
@@ -94,16 +101,20 @@ class _ProtocolState:
 
     # ------------------------------------------------------------------
     # TUNE side
+    def _load_params_meta(self) -> None:
+        """Load ut.params.json (if present) for positional binding."""
+        ppath = os.path.join(self.work_dir, PARAMS_FILE)
+        if os.path.exists(ppath):
+            with open(ppath) as f:
+                self.params_meta = json.load(f)
+
     def _load_proposal(self) -> None:
         cfg_dir = os.path.join(self.work_dir, "configs")
         path = os.path.join(
             cfg_dir, f"ut.dr_stage{self.stage}_index{self.index}.json")
         with open(path) as f:
             self.proposal = json.load(f)
-        ppath = os.path.join(self.work_dir, PARAMS_FILE)
-        if os.path.exists(ppath):
-            with open(ppath) as f:
-                self.params_meta = json.load(f)
+        self._load_params_meta()
         # merge best configs of earlier stages (template/access.py:19-25,
         # types.py:124-129): stage s trials replay stages < s from their
         # published best
@@ -118,7 +129,20 @@ class _ProtocolState:
     def _load_best(self) -> None:
         path = os.path.join(self.work_dir, BEST_FILE)
         with open(path) as f:
-            self.proposal = json.load(f)
+            best = json.load(f)
+        # controller writes {"config": {...}, "qor": q}; also accept a
+        # bare config dict or the reference's [config, qor] list shape
+        if isinstance(best, dict):
+            self.proposal = best.get("config", best)
+        elif (isinstance(best, list) and len(best) == 2
+              and isinstance(best[0], dict)):
+            self.proposal = best[0]
+        else:
+            raise ValueError(f"unrecognized best.json payload: {best!r}")
+        # params metadata enables the positional-counter fallback for
+        # unnamed ut.tune() calls (the reference's common style,
+        # types.py:132-134) in BEST mode too
+        self._load_params_meta()
 
     def next_value(self, name: Optional[str], default: Any) -> Any:
         """Serve the value for the next ut.tune() call."""
@@ -126,8 +150,8 @@ class _ProtocolState:
             try:
                 (self._load_best if self.mode == BEST
                  else self._load_proposal)()
-            except (OSError, json.JSONDecodeError):
-                return default  # no published config: run as default
+            except (OSError, json.JSONDecodeError, ValueError):
+                return default  # no/bad published config: run as default
         key = None
         if name and name in self.proposal:
             key = name
@@ -144,10 +168,12 @@ class _ProtocolState:
 
     # ------------------------------------------------------------------
     # QoR side
-    def write_qor(self, value: Any, trend: str) -> None:
-        """Single-stage: append [-1, val, trend] rows (report.py:62-66);
-        multi-stage breakpoints handled by report.target."""
-        path = f"ut.qor_stage{self.cur_stage}.json"
+    def write_qor_row(self, index: int, value: Any, trend: str) -> None:
+        """Append an [index, val, trend] row to the current stage's QoR
+        file (the reference's row shape, report.py:62-79); multi-stage
+        breakpoint control flow lives in report.target."""
+        path = os.path.join(self.work_dir,
+                            f"ut.qor_stage{self.cur_stage}.json")
         rows = []
         if os.path.exists(path):
             try:
@@ -155,7 +181,7 @@ class _ProtocolState:
                     rows = json.load(f)
             except json.JSONDecodeError:
                 rows = []
-        rows.append([-1, value, trend])
+        rows.append([index, value, trend])
         with open(path, "w") as f:
             json.dump(rows, f)
 

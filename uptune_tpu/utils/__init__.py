@@ -1,0 +1,1 @@
+"""Shared utilities: platform guards, logging, observability."""

@@ -1,0 +1,45 @@
+"""Force a virtual multi-device CPU platform, safely.
+
+The machine environment registers an experimental `axon` TPU-tunnel
+backend whose PJRT client dials the tunnel during backends()
+initialization — even under JAX_PLATFORMS=cpu — and hangs the process if
+the tunnel is wedged (observed: 300 s+).  Every CPU-only entry point
+(tests, dryruns, bench fallback) must therefore (a) select the cpu
+platform, (b) size the virtual device count, and (c) drop the axon
+backend factory BEFORE any JAX backend initializes.
+
+This module is importable without importing jax at module scope, so it is
+safe to call from conftest-style preambles.  Must be called before the
+first jax backend initialization to take full effect.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Select the CPU platform with >= n_devices virtual devices and
+    drop the axon TPU-tunnel backend factory."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = _COUNT_RE.search(flags)
+    if m is None:
+        flags = (flags +
+                 f" --xla_force_host_platform_device_count={n_devices}")
+    elif int(m.group(1)) < n_devices:
+        flags = _COUNT_RE.sub(
+            f"--xla_force_host_platform_device_count={n_devices}", flags)
+    os.environ["XLA_FLAGS"] = flags.strip()
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass  # private API moved: the env vars above still select cpu
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
