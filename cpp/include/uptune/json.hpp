@@ -1,0 +1,325 @@
+// Minimal JSON value model + parser/serializer for the uptune C++ client.
+//
+// The client only needs the protocol subset the controller emits
+// (objects, arrays, strings, numbers, bools, null) — see
+// uptune_tpu/api/state.py for the files exchanged.  Dependency-free by
+// design: the reference's C++ API (src/uptune.h:14-47) left its JSON
+// handling unimplemented; this completes it without pulling a library
+// into user build systems.
+#pragma once
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace uptune {
+namespace json {
+
+class Value;
+using Array = std::vector<Value>;
+using Object = std::map<std::string, Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int v) : type_(Type::Number), num_(v) {}
+  Value(long v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Value(long long v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Value(double v) : type_(Type::Number), num_(v) {}
+  Value(const char* s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array), arr_(std::move(a)) {}
+  Value(Object o) : type_(Type::Object), obj_(std::move(o)) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_bool() const { return type_ == Type::Bool; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_object() const { return type_ == Type::Object; }
+
+  bool as_bool() const { require(Type::Bool); return bool_; }
+  double as_double() const { require(Type::Number); return num_; }
+  long long as_int() const {
+    require(Type::Number);
+    return static_cast<long long>(std::llround(num_));
+  }
+  const std::string& as_string() const { require(Type::String); return str_; }
+  const Array& as_array() const { require(Type::Array); return arr_; }
+  Array& as_array() { require(Type::Array); return arr_; }
+  const Object& as_object() const { require(Type::Object); return obj_; }
+  Object& as_object() { require(Type::Object); return obj_; }
+
+  bool contains(const std::string& key) const {
+    return is_object() && obj_.count(key) > 0;
+  }
+  const Value& at(const std::string& key) const {
+    require(Type::Object);
+    auto it = obj_.find(key);
+    if (it == obj_.end()) throw std::out_of_range("json: no key " + key);
+    return it->second;
+  }
+  const Value& at(size_t i) const {
+    require(Type::Array);
+    return arr_.at(i);
+  }
+  size_t size() const {
+    if (is_array()) return arr_.size();
+    if (is_object()) return obj_.size();
+    return 0;
+  }
+
+  std::string dump() const {
+    std::ostringstream os;
+    write(os);
+    return os.str();
+  }
+
+ private:
+  void require(Type t) const {
+    if (type_ != t) throw std::runtime_error("json: wrong type access");
+  }
+
+  void write(std::ostringstream& os) const {
+    switch (type_) {
+      case Type::Null: os << "null"; break;
+      case Type::Bool: os << (bool_ ? "true" : "false"); break;
+      case Type::Number: {
+        if (std::isfinite(num_) &&
+            num_ == std::floor(num_) && std::fabs(num_) < 1e15) {
+          os << static_cast<long long>(num_);
+        } else if (std::isnan(num_)) {
+          os << "NaN";            // python json reads this back
+        } else if (std::isinf(num_)) {
+          os << (num_ > 0 ? "Infinity" : "-Infinity");
+        } else {
+          os.precision(17);
+          os << num_;
+        }
+        break;
+      }
+      case Type::String: write_string(os, str_); break;
+      case Type::Array: {
+        os << '[';
+        for (size_t i = 0; i < arr_.size(); ++i) {
+          if (i) os << ", ";
+          arr_[i].write(os);
+        }
+        os << ']';
+        break;
+      }
+      case Type::Object: {
+        os << '{';
+        bool first = true;
+        for (const auto& kv : obj_) {
+          if (!first) os << ", ";
+          first = false;
+          write_string(os, kv.first);
+          os << ": ";
+          kv.second.write(os);
+        }
+        os << '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(std::ostringstream& os, const std::string& s) {
+    os << '"';
+    for (char c : s) {
+      switch (c) {
+        case '"': os << "\\\""; break;
+        case '\\': os << "\\\\"; break;
+        case '\n': os << "\\n"; break;
+        case '\t': os << "\\t"; break;
+        case '\r': os << "\\r"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            os << buf;
+          } else {
+            os << c;
+          }
+      }
+    }
+    os << '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0.0;
+  std::string str_;
+  Array arr_;
+  Object obj_;
+};
+
+// ---------------------------------------------------------------- parser
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  Value parse() {
+    Value v = value();
+    skip_ws();
+    if (pos_ != s_.size()) fail("trailing characters");
+    return v;
+  }
+
+ private:
+  [[noreturn]] void fail(const std::string& why) {
+    throw std::runtime_error("json parse error at byte " +
+                             std::to_string(pos_) + ": " + why);
+  }
+
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           std::isspace(static_cast<unsigned char>(s_[pos_]))) {
+      ++pos_;
+    }
+  }
+
+  char peek() {
+    if (pos_ >= s_.size()) fail("unexpected end");
+    return s_[pos_];
+  }
+
+  bool consume(const std::string& word) {
+    if (s_.compare(pos_, word.size(), word) == 0) {
+      pos_ += word.size();
+      return true;
+    }
+    return false;
+  }
+
+  Value value() {
+    skip_ws();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') return Value(string());
+    if (consume("true")) return Value(true);
+    if (consume("false")) return Value(false);
+    if (consume("null")) return Value(nullptr);
+    // python's json emits these for non-finite floats
+    if (consume("NaN")) return Value(std::nan(""));
+    if (consume("Infinity")) return Value(HUGE_VAL);
+    if (consume("-Infinity")) return Value(-HUGE_VAL);
+    return number();
+  }
+
+  Value object() {
+    ++pos_;  // {
+    Object out;
+    skip_ws();
+    if (peek() == '}') { ++pos_; return Value(std::move(out)); }
+    while (true) {
+      skip_ws();
+      std::string key = string();
+      skip_ws();
+      if (peek() != ':') fail("expected ':'");
+      ++pos_;
+      out[key] = value();
+      skip_ws();
+      char c = peek();
+      ++pos_;
+      if (c == '}') break;
+      if (c != ',') fail("expected ',' or '}'");
+    }
+    return Value(std::move(out));
+  }
+
+  Value array() {
+    ++pos_;  // [
+    Array out;
+    skip_ws();
+    if (peek() == ']') { ++pos_; return Value(std::move(out)); }
+    while (true) {
+      out.push_back(value());
+      skip_ws();
+      char c = peek();
+      ++pos_;
+      if (c == ']') break;
+      if (c != ',') fail("expected ',' or ']'");
+    }
+    return Value(std::move(out));
+  }
+
+  std::string string() {
+    if (peek() != '"') fail("expected string");
+    ++pos_;
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) fail("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) fail("bad escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'n': out += '\n'; break;
+          case 'r': out += '\r'; break;
+          case 't': out += '\t'; break;
+          case 'u': {
+            if (pos_ + 4 > s_.size()) fail("bad \\u escape");
+            unsigned cp = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // encode BMP code point as UTF-8 (enough for the protocol)
+            if (cp < 0x80) {
+              out += static_cast<char>(cp);
+            } else if (cp < 0x800) {
+              out += static_cast<char>(0xC0 | (cp >> 6));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (cp >> 12));
+              out += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  Value number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    while (pos_ < s_.size() &&
+           (std::isdigit(static_cast<unsigned char>(s_[pos_])) ||
+            s_[pos_] == '.' || s_[pos_] == 'e' || s_[pos_] == 'E' ||
+            s_[pos_] == '+' || s_[pos_] == '-')) {
+      ++pos_;
+    }
+    if (pos_ == start) fail("expected number");
+    return Value(std::stod(s_.substr(start, pos_ - start)));
+  }
+
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline Value parse(const std::string& text) { return Parser(text).parse(); }
+
+}  // namespace json
+}  // namespace uptune

@@ -1,0 +1,15 @@
+"""Two-stage pipeline demo (the reference's
+`samples/decomposed/decompsed.py:1-14` shape): two `ut.target` calls act
+as stage breakpoints, so the CLI auto-decouples tuning — stage 1 trials
+replay stage 0's best config."""
+import uptune_tpu as ut
+
+# stage 0: pick a quantization scale
+scale = ut.tune(8, (1, 32), name="scale")
+err0 = abs(scale - 24) / 24.0
+ut.target(float(err0), "min")
+
+# stage 1: pick a schedule given the chosen scale
+unroll = ut.tune(1, [1, 2, 4, 8, 16], name="unroll")
+cost = err0 + abs(unroll * scale - 96) / 96.0
+ut.target(float(cost), "min")

@@ -1,0 +1,18 @@
+"""Minimal single-stage black-box demo (the shape of the reference's
+`samples/hash/single_stage.py:1-15`): tune multiplier/shift constants of
+a toy hash over a fixed key set, minimizing bucket collisions."""
+import uptune_tpu as ut
+
+mult = ut.tune(31, (3, 1023), name="mult")
+shift = ut.tune(4, (0, 16), name="shift")
+buckets = ut.tune(64, [32, 64, 128, 256], name="buckets")
+
+keys = [k * 2654435761 % (1 << 32) for k in range(257)]
+seen = {}
+collisions = 0
+for k in keys:
+    h = ((k * mult) >> shift) % buckets
+    collisions += seen.get(h, 0)
+    seen[h] = seen.get(h, 0) + 1
+
+ut.target(float(collisions), "min")

@@ -1,0 +1,18 @@
+"""Template (non-intrusive) variant of the hash demo: the tunables are
+declared in comment annotations; the source itself stays runnable as-is
+(the reference's `samples/hash/single_stage_template.py:1-6` shape)."""
+import uptune_tpu as ut
+
+mult = 31       # {% mult = TuneInt(31, (3, 1023)) %}
+shift = 4       # {% shift = TuneInt(4, (0, 16)) %}
+buckets = 64    # {% buckets = TuneEnum(64, [32, 64, 128, 256]) %}
+
+keys = [k * 2654435761 % (1 << 32) for k in range(257)]
+seen = {}
+collisions = 0
+for k in keys:
+    h = ((k * mult) >> shift) % buckets
+    collisions += seen.get(h, 0)
+    seen[h] = seen.get(h, 0) + 1
+
+ut.target(float(collisions), "min")

@@ -1,0 +1,214 @@
+"""Black-box evaluation plane tests: subprocess measurement, sandboxed
+worker pool with timeout kill + dead-worker replacement, and the
+ProgramTuner end-to-end loop (the reference's api.py:399-594 +
+src/single_stage.py semantics)."""
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+import uptune_tpu
+from uptune_tpu.api import constraint as C
+from uptune_tpu.api import session
+from uptune_tpu.exec import (ProgramTuner, WorkerPool, call_program,
+                             default_config, space_from_params)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+ENV = {"PYTHONPATH": REPO}
+
+QUAD_PROG = textwrap.dedent("""
+    import uptune_tpu as ut
+    x = ut.tune(50, (0, 100), name="x")
+    y = ut.tune(50, (0, 100), name="y")
+    ut.target(float((x - 37) ** 2 + (y - 11) ** 2), "min")
+""")
+
+SLOW_PROG = textwrap.dedent("""
+    import time
+    import uptune_tpu as ut
+    x = ut.tune(80, (0, 100), name="x")
+    if x < 50:
+        time.sleep(60)          # hangs; must be killed by the pool
+    ut.target(float(abs(x - 75)), "min")
+""")
+
+
+@pytest.fixture(autouse=True)
+def clean_registry(monkeypatch):
+    for v in ("UT_BEFORE_RUN_PROFILE", "UT_TUNE_START", "BEST",
+              "UT_WORK_DIR"):
+        monkeypatch.delenv(v, raising=False)
+    C.REGISTRY.clear()
+    session.reset_settings()
+    yield
+
+
+def _write(tmp_path, body, name="prog.py"):
+    p = tmp_path / name
+    p.write_text(body)
+    return str(p)
+
+
+# ---------------------------------------------------------------------
+class TestCallProgram:
+    def test_basic_capture(self):
+        res = call_program([sys.executable, "-c", "print('hi')"])
+        assert res["returncode"] == 0 and res["stdout"].strip() == "hi"
+        assert not res["timeout"]
+
+    def test_timeout_kills_process_group(self):
+        # child spawns a grandchild; both must die within the limit
+        code = ("import subprocess, sys, time; "
+                "subprocess.Popen([sys.executable, '-c', "
+                "'import time; time.sleep(60)']); time.sleep(60)")
+        t0 = time.time()
+        res = call_program([sys.executable, "-c", code], limit=1.0)
+        assert res["timeout"] and time.time() - t0 < 10
+
+    def test_failure_rc(self):
+        res = call_program([sys.executable, "-c", "raise SystemExit(3)"])
+        assert res["returncode"] == 3
+
+
+# ---------------------------------------------------------------------
+class TestSpaceIO:
+    def test_round_trip(self):
+        recs = [
+            {"name": "i", "type": "int", "default": 3, "lo": 1, "hi": 9},
+            {"name": "f", "type": "float", "default": 0.5, "lo": 0.0,
+             "hi": 2.0},
+            {"name": "b", "type": "bool", "default": True},
+            {"name": "e", "type": "enum", "default": "-O2",
+             "options": ["-O1", "-O2", "-O3"]},
+            {"name": "p", "type": "perm", "default": [0, 1, 2],
+             "items": [0, 1, 2]},
+        ]
+        space = space_from_params(recs)
+        assert len(space) == 5
+        dflt = default_config(recs)
+        assert dflt == {"i": 3, "f": 0.5, "b": True, "e": "-O2",
+                        "p": [0, 1, 2]}
+        cands = space.from_configs([dflt])
+        cfg = space.to_configs(cands)[0]
+        assert cfg["i"] == 3 and cfg["e"] == "-O2"
+        assert list(cfg["p"]) == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------
+def _mk_tuner(tmp_path, body, **kw):
+    prog = _write(tmp_path, body)
+    kw.setdefault("parallel", 2)
+    kw.setdefault("env", ENV)
+    kw.setdefault("runtime_limit", 30.0)
+    return ProgramTuner([sys.executable, prog], str(tmp_path), **kw)
+
+
+class TestProgramTuner:
+    def test_analysis_discovers_space(self, tmp_path):
+        pt = _mk_tuner(tmp_path, QUAD_PROG)
+        params = pt.analyze()
+        assert [r["name"] for r in params[0]] == ["x", "y"]
+        assert pt.sense == "min"
+        # default (50,50): (13)^2 + (39)^2
+        assert pt.default_qor == 13 ** 2 + 39 ** 2
+
+    def test_end_to_end_tunes_and_persists_best(self, tmp_path):
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=40, seed=1)
+        res = pt.run()
+        assert res.evals >= 40
+        # must improve on the default config's QoR
+        assert res.best_qor < 13 ** 2 + 39 ** 2
+        assert 0 <= res.best_config["x"] <= 100
+        # best.json round trip
+        cfg, qor = uptune_tpu.get_best(str(tmp_path))
+        assert qor == res.best_qor
+        # archive carries technique attribution incl. the seed trial
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        assert rows[0]["tech"] == "seed"
+        assert all("tech" in r for r in rows)
+        assert len({r["tech"] for r in rows}) >= 1
+
+    def test_timeout_kill_and_worker_replacement(self, tmp_path):
+        pt = _mk_tuner(tmp_path, SLOW_PROG, test_limit=8, seed=3,
+                       runtime_limit=1.0)
+        t0 = time.time()
+        res = pt.run()
+        took = time.time() - t0
+        # some trials (x < 50) hung and were killed + replaced
+        assert pt.pool.replaced >= 1
+        assert res.evals >= 8
+        assert took < 120
+        # the survivors still tuned toward x=75
+        assert res.best_qor <= abs(80 - 75)  # at least the default
+
+    def test_rules_restrict_search_space(self, tmp_path):
+        @uptune_tpu.rule()
+        def x_small(cfg):
+            return cfg["x"] <= 20
+
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=20, seed=5)
+        res = pt.run()
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        evaluated = [r for r in rows if r["tech"] != "seed"]
+        assert evaluated and all(r["cfg"]["x"] <= 20 for r in evaluated)
+        assert pt.tuner.filtered_total > 0
+
+    def test_constraint_marks_violations_failed(self, tmp_path):
+        @uptune_tpu.constraint()
+        def qor_cap(qor, cfg):
+            return qor < 500.0
+
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=20, seed=7)
+        res = pt.run()
+        assert res.best_qor < 500.0
+
+    def test_custom_model_proposals_are_injected(self, tmp_path):
+        @uptune_tpu.model("oracle")
+        def oracle(history, space):
+            return {"x": 37, "y": 11}   # the optimum
+
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=12, seed=9)
+        res = pt.run()
+        assert res.best_qor == 0.0
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        assert any(r["tech"] == "oracle" for r in rows)
+
+    def test_params_reuse_skips_analysis(self, tmp_path):
+        prog = _write(tmp_path, QUAD_PROG)
+        with open(tmp_path / "ut.params.json", "w") as f:
+            json.dump([[{"name": "x", "type": "int", "default": 50,
+                         "lo": 0, "hi": 100},
+                        {"name": "y", "type": "int", "default": 50,
+                         "lo": 0, "hi": 100}]], f)
+        pt = ProgramTuner([sys.executable, prog], str(tmp_path),
+                          parallel=2, env=ENV, runtime_limit=30.0)
+        params = pt.analyze()   # must NOT re-run the program
+        assert params[0][0]["name"] == "x"
+        assert pt.default_qor is None  # no profiling run happened
+
+
+# ---------------------------------------------------------------------
+class TestWorkerPoolSandbox:
+    def test_sandboxes_isolate_and_symlink(self, tmp_path):
+        _write(tmp_path, QUAD_PROG)
+        (tmp_path / "data.txt").write_text("shared")
+        with open(tmp_path / "ut.params.json", "w") as f:
+            json.dump([[{"name": "x", "type": "int", "default": 1,
+                         "lo": 0, "hi": 9}]], f)
+        pool = WorkerPool("true", str(tmp_path), 2)
+        pool.start()
+        for i in range(2):
+            sb = tmp_path / "ut.temp" / f"temp.{i}"
+            assert (sb / "prog.py").is_symlink()
+            assert (sb / "data.txt").read_text() == "shared"
+            # params copied, not symlinked: per-sandbox protocol state
+            assert (sb / "ut.params.json").is_file()
+            assert not (sb / "ut.params.json").is_symlink()
+        pool.shutdown()

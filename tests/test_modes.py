@@ -1,0 +1,174 @@
+"""CLI, template-mode, decouple-mode and multi-stage-mode tests
+(reference parity: on.py:8-55, src/codegen.py:153-196,
+async_task_scheduler.py:106-238, src/multi_stage.py:50-165)."""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import uptune_tpu
+from uptune_tpu.api import constraint as C
+from uptune_tpu.api import session
+from uptune_tpu.exec.controller import ProgramTuner
+from uptune_tpu.exec.multistage import (DecoupledTuner, MultiStageTuner,
+                                        run_auto, select_mode)
+from uptune_tpu.exec.template import TemplateProgram, detect_template
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+ENV = {"PYTHONPATH": REPO}
+SAMPLES = os.path.join(REPO, "samples")
+
+
+@pytest.fixture(autouse=True)
+def clean_registry(monkeypatch):
+    for v in ("UT_BEFORE_RUN_PROFILE", "UT_TUNE_START", "BEST",
+              "UT_WORK_DIR", "UT_MULTI_STAGE_SAMPLE"):
+        monkeypatch.delenv(v, raising=False)
+    C.REGISTRY.clear()
+    session.reset_settings()
+    yield
+
+
+# ---------------------------------------------------------------------
+class TestTemplate:
+    TPL = textwrap.dedent("""\
+        import uptune_tpu as ut
+        a = 5           # {% a = TuneInt(5, (0, 50)) %}
+        opt = '-O1'     # {% opt = TuneEnum('-O1', ['-O1','-O2','-O3'], 'level') %}
+        flag = False    # {% flag = TuneBool(False) %}
+        ut.target(float(a + (10 if opt == '-O1' else 0)), "min")
+    """)
+
+    def test_extract_records(self, tmp_path):
+        p = tmp_path / "prog.py"
+        p.write_text(self.TPL)
+        tp = TemplateProgram(str(p))
+        assert [r["name"] for r in tp.records] == ["a", "level", "flag"]
+        assert tp.records[0] == {"name": "a", "type": "int", "default": 5,
+                                 "lo": 0, "hi": 50}
+        assert tp.records[1]["options"] == ["-O1", "-O2", "-O3"]
+
+    def test_render_applies_config(self, tmp_path):
+        p = tmp_path / "prog.py"
+        p.write_text(self.TPL)
+        tp = TemplateProgram(str(p))
+        out = tp.render({"a": 7, "level": "-O3", "flag": True})
+        assert "a = 7\n" in out
+        assert "opt = '-O3'" in out
+        assert "flag = True" in out
+        # defaults fill unspecified values
+        out2 = tp.render({"a": 9})
+        assert "opt = '-O1'" in out2
+
+    def test_non_template_detection(self, tmp_path):
+        p = tmp_path / "plain.py"
+        p.write_text("print('no annotations')\n")
+        assert detect_template(str(p)) is None
+
+    def test_template_end_to_end(self, tmp_path):
+        p = tmp_path / "prog.py"
+        p.write_text(self.TPL)
+        pt = ProgramTuner([sys.executable, str(p)], str(tmp_path),
+                          parallel=2, env=ENV, runtime_limit=30.0,
+                          test_limit=20, seed=11,
+                          template=TemplateProgram(str(p)))
+        res = pt.run()
+        # optimum: a=0, opt != -O1 -> qor 0
+        assert res.best_qor < 15.0   # default is 15
+
+
+# ---------------------------------------------------------------------
+class TestDecouple:
+    def test_mode_detection_and_run(self, tmp_path):
+        shutil.copy(os.path.join(SAMPLES, "decomposed", "decomposed.py"),
+                    tmp_path / "decomposed.py")
+        pt = ProgramTuner(
+            [sys.executable, str(tmp_path / "decomposed.py")],
+            str(tmp_path), parallel=2, env=ENV, runtime_limit=30.0,
+            test_limit=15, seed=13)
+        pt.analyze()
+        assert select_mode(pt) == "decouple"
+        assert len(pt.params) == 2
+        assert pt.params[0][0]["name"] == "scale"
+        assert pt.params[1][0]["name"] == "unroll"
+        res = DecoupledTuner(pt).run()
+        assert set(res.best_config) == {"scale", "unroll"}
+        # stage-0 best was published for stage-1 replay
+        assert os.path.isfile(tmp_path / "configs" / "0-best.json")
+        # both stage archives exist with attribution
+        for s in range(2):
+            rows = [json.loads(l) for l in
+                    open(tmp_path / f"ut.archive_stage{s}.jsonl")][1:]
+            assert rows and all("tech" in r for r in rows)
+        # default pipeline cost: err0(8)=0.666, cost=0.666+|8-96|/96
+        assert res.best_qor < 1.58
+
+
+# ---------------------------------------------------------------------
+MULTI_PROG = textwrap.dedent("""\
+    import uptune_tpu as ut
+    x = ut.tune(0, (0, 100), name="x")
+    y = ut.tune(0, (0, 100), name="y")
+    ut.interm([float(x), float(y)])
+    ut.target(float((x - 60) ** 2 + (y - 20) ** 2), "min")
+""")
+
+
+class TestMultiStage:
+    def test_pre_post_epochs(self, tmp_path):
+        p = tmp_path / "prog.py"
+        p.write_text(MULTI_PROG)
+        pt = ProgramTuner([sys.executable, str(p)], str(tmp_path),
+                          parallel=2, env=ENV, runtime_limit=30.0,
+                          test_limit=16, seed=17)
+        pt.analyze()
+        assert select_mode(pt) == "multistage"
+        ms = MultiStageTuner(pt, cand_factor=3, retrain_interval=1)
+        res = ms.run()
+        assert res.evals >= 16
+        # the pre-phase pool saw cand_factor x more trials than evals
+        assert ms.surrogate._ys    # online (features, qor) pairs recorded
+        assert res.best_qor < (60 ** 2 + 20 ** 2)  # beat the default
+
+
+# ---------------------------------------------------------------------
+class TestCLI:
+    def _run(self, args, cwd):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        return subprocess.run(
+            [sys.executable, "-m", "uptune_tpu.cli"] + args,
+            capture_output=True, text=True, cwd=cwd, env=env, timeout=300)
+
+    def test_list_techniques(self, tmp_path):
+        out = self._run(["--list-techniques"], str(tmp_path))
+        assert out.returncode == 0
+        names = out.stdout.split()
+        assert "de" in names or any("de" in n for n in names)
+        assert len(names) >= 30
+
+    def test_tune_and_apply_best(self, tmp_path):
+        shutil.copy(os.path.join(SAMPLES, "hash", "single_stage.py"),
+                    tmp_path / "prog.py")
+        out = self._run(["prog.py", "-pf", "2", "--test-limit", "15",
+                         "--seed", "3"], str(tmp_path))
+        assert out.returncode == 0, out.stderr[-800:]
+        last = json.loads(out.stdout.strip().splitlines()[-1])
+        assert "best_config" in last and last["evals"] >= 15
+        assert (tmp_path / "best.json").is_file()
+        # --apply-best re-runs the program with the stored best
+        out2 = self._run(["prog.py", "--apply-best"], str(tmp_path))
+        assert out2.returncode == 0, out2.stderr[-800:]
+
+    def test_print_search_space_size(self, tmp_path):
+        shutil.copy(os.path.join(SAMPLES, "hash", "single_stage.py"),
+                    tmp_path / "prog.py")
+        out = self._run(["prog.py", "--print-search-space-size"],
+                        str(tmp_path))
+        assert out.returncode == 0, out.stderr[-800:]
+        assert "log10(size)" in out.stdout

@@ -1,0 +1,162 @@
+"""`ut` — the command-line entry point.
+
+Mirrors the reference CLI (`/root/reference/python/uptune/on.py:8-55` +
+the aggregated argparsers, `python/uptune/__init__.py:122-141`):
+
+    ut prog.py -pf 4 --test-limit 200
+    ut prog.py --technique de --technique pso
+    ut --list-techniques
+    ut prog.py --apply-best          # re-run with the best found config
+
+Flag precedence is flags > ut.config(...) > defaults
+(tests/python/test_async_execute.py:5-14 contract): any flag left unset
+falls back to the session settings dict.  Mode selection is automatic
+(async_task_scheduler.py:465-474): template annotations in the script
+select template mode; >1 stage in ut.params.json selects multi-stage.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ut", description="uptune-tpu: TPU-native program autotuner")
+    p.add_argument("script", nargs="?", help="program to tune")
+    p.add_argument("script_args", nargs="*",
+                   help="arguments passed through to the program")
+    p.add_argument("-pf", "--parallel-factor", type=int, default=None,
+                   help="parallel evaluation width")
+    p.add_argument("--test-limit", type=int, default=None,
+                   help="number of trials")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="total tuning wall-clock limit (s)")
+    p.add_argument("--runtime-limit", type=float, default=None,
+                   help="per-trial wall-clock limit (s)")
+    p.add_argument("-t", "--technique", action="append", default=None,
+                   help="search technique (repeatable); default: AUC "
+                        "bandit portfolio")
+    p.add_argument("--seed", type=int, default=None, help="RNG seed")
+    p.add_argument("--params", default=None,
+                   help="reuse an existing ut.params.json")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the trial archive")
+    p.add_argument("--work-dir", default=None,
+                   help="work directory (default: cwd)")
+    p.add_argument("--no-sandbox", action="store_true",
+                   help="run trials directly in the work dir")
+    p.add_argument("--apply-best", action="store_true",
+                   help="run the program once with the best config")
+    p.add_argument("--list-techniques", action="store_true",
+                   help="list registered search techniques and exit")
+    p.add_argument("--print-search-space-size", action="store_true",
+                   help="analyze, print log10(space size) and exit")
+    p.add_argument("--print-params", action="store_true",
+                   help="analyze, print the param records and exit")
+    p.add_argument("--cfg", action="store_true",
+                   help="print the resolved configuration")
+    p.add_argument("--device", choices=("cpu", "accel"), default="cpu",
+                   help="platform for the search engine (default cpu: "
+                        "black-box evals dominate; 'accel' trusts the "
+                        "environment's accelerator config)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def _configure_logging(verbose: bool) -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if verbose else logging.INFO,
+        format="[%(relativeCreated)7.0fms] %(levelname)s %(message)s")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    _configure_logging(args.verbose)
+    log = logging.getLogger("uptune_tpu")
+    if args.device == "cpu":
+        # the proposal engine is cheap next to black-box evals; default
+        # to the (hang-proof) host platform unless --device accel
+        from .utils.platform_guard import force_cpu
+        force_cpu(1)
+
+    if args.list_techniques:
+        from .techniques.base import all_technique_names
+        for name in all_technique_names():
+            print(name)
+        return 0
+    if not args.script:
+        print("ut: a script to tune is required", file=sys.stderr)
+        return 2
+
+    script = os.path.abspath(args.script)
+    if not os.path.isfile(script):
+        print(f"ut: no such file {script}", file=sys.stderr)
+        return 2
+    work_dir = os.path.abspath(args.work_dir or os.path.dirname(script)
+                               or os.getcwd())
+
+    if args.apply_best:
+        from .exec.measure import call_program
+        env = dict(os.environ)
+        env.update({"BEST": "True", "UPTUNE": "True",
+                    "UT_WORK_DIR": work_dir})
+        res = call_program([sys.executable, script] + args.script_args,
+                           env=env, cwd=work_dir, capture=False)
+        return res["returncode"]
+
+    from .api.session import settings
+    from .exec.controller import ProgramTuner
+    from .exec.template import detect_template
+
+    template = None
+    if script.endswith((".py", ".tpl")):
+        try:
+            template = detect_template(script)
+        except ValueError as e:
+            print(f"ut: {e}", file=sys.stderr)
+            return 2
+
+    technique = args.technique
+    if technique is not None and len(technique) == 1:
+        technique = technique[0]
+
+    pt = ProgramTuner(
+        [sys.executable, script] + args.script_args, work_dir,
+        parallel=args.parallel_factor, test_limit=args.test_limit,
+        runtime_limit=args.runtime_limit, timeout=args.timeout,
+        technique=technique, seed=args.seed, params_file=args.params,
+        resume=args.resume, sandbox=not args.no_sandbox,
+        template=template)
+
+    if args.cfg:
+        for k in sorted(settings):
+            print(f"  {k} = {settings[k]}")
+
+    params = pt.analyze()
+    if args.print_params:
+        print(json.dumps(params, indent=1))
+        return 0
+    if args.print_search_space_size:
+        import math
+        from .exec.space_io import stage_spaces
+        for s, space in enumerate(stage_spaces(params)):
+            size = space.search_space_size()
+            print(f"stage {s}: log10(size) = "
+                  f"{math.log10(size) if size else 0:.2f}")
+        return 0
+
+    from .exec.multistage import run_auto
+    res = run_auto(pt)   # single / multi-stage / decouple auto-dispatch
+    log.info("[ut] done: best qor=%.6g evals=%d", res.best_qor, res.evals)
+    print(json.dumps({"best_config": res.best_config,
+                      "best_qor": res.best_qor, "evals": res.evals}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

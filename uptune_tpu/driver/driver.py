@@ -47,6 +47,56 @@ class StepStats(NamedTuple):
     pruned: int = 0
 
 
+class Trial:
+    """One proposed configuration awaiting an external result (the
+    ask/tell unit, mirroring the reference's DesiredResult lifecycle
+    UNKNOWN->REQUESTED->RUNNING->COMPLETE, resultsdb/models.py:284-287)."""
+
+    __slots__ = ("gid", "config", "ticket", "slot", "row", "qor", "dur",
+                 "cancelled")
+
+    def __init__(self, gid: int, config: Dict[str, Any], ticket: "_Ticket",
+                 slot: int, row: int):
+        self.gid = gid
+        self.config = config
+        self.ticket = ticket
+        self.slot = slot          # index within the ticket's trial list
+        self.row = row            # row within the proposed device batch
+        self.qor: Optional[float] = None   # ENGINE orientation once told
+        self.dur = 0.0
+        self.cancelled = False
+
+    def __repr__(self):
+        return (f"Trial(gid={self.gid}, tech={self.ticket.arm_name!r}, "
+                f"qor={self.qor})")
+
+
+class _Ticket:
+    """One arm's proposed batch plus its dedup verdicts; completes when
+    every novel trial has been told its result."""
+
+    __slots__ = ("arm", "arm_name", "tstate", "cands", "hashes", "known",
+                 "src", "novel_np", "injected", "pruned", "trials",
+                 "remaining", "u_np", "perms_np")
+
+    def __init__(self, arm, arm_name, tstate, cands, hashes, known, src,
+                 novel_np, injected, pruned):
+        self.arm = arm
+        self.arm_name = arm_name
+        self.tstate = tstate
+        self.cands = cands
+        self.hashes = hashes
+        self.known = known
+        self.src = src
+        self.novel_np = novel_np
+        self.injected = injected
+        self.pruned = pruned
+        self.trials: List[Trial] = []
+        self.remaining = 0
+        self.u_np = None
+        self.perms_np = None
+
+
 class TuneResult(NamedTuple):
     best_config: Dict[str, Any]
     best_qor: float          # in USER orientation (negated back for 'max')
@@ -72,15 +122,21 @@ class Tuner:
     archive : optional path of the jsonl trial archive (resume source).
     """
 
-    def __init__(self, space: Space, objective: Objective, *,
-                 technique=None, seed: int = 0, sense: str = "min",
+    def __init__(self, space: Space, objective: Optional[Objective] = None,
+                 *, technique=None, seed: int = 0, sense: str = "min",
                  capacity: int = 1 << 16,
                  archive: Optional[str] = None,
                  resume: bool = False,
-                 surrogate=None, surrogate_opts: Optional[dict] = None):
+                 surrogate=None, surrogate_opts: Optional[dict] = None,
+                 config_filter: Optional[
+                     Callable[[Dict[str, Any]], bool]] = None):
         assert sense in ("min", "max"), sense
         self.space = space
         self.objective = objective
+        # search-space restriction predicate (ut.rule); rejected configs
+        # are never evaluated/archived and serve +inf to their technique
+        self.config_filter = config_filter
+        self.filtered_total = 0
         self.sense = sense
         self.sign = 1.0 if sense == "min" else -1.0
         self.key = jax.random.PRNGKey(seed)
@@ -95,6 +151,11 @@ class Tuner:
         self._zero_novel_streak = 0
         self._cap_warned = False
         self.pruned_total = 0
+        # hashes proposed but not yet resolved (the reference's _pending
+        # list, api.py:254-280): asked trials must not be re-proposed
+        self._pending: set = set()
+        # per-technique attribution counters (pulls, evals, new-bests)
+        self.arm_stats: Dict[str, List[int]] = {}
 
         # surrogate-ensemble pruning (api.py:291-326 semantics)
         if isinstance(surrogate, str):
@@ -256,11 +317,14 @@ class Tuner:
             running = min(running, float(q))
             self.trace.append(self.sign * running)
 
-    def _log_trial(self, cfg, u_row, perm_rows, qor, is_best, dur) -> None:
-        self.gid += 1
+    def _log_trial(self, gid, tech, cfg, u_row, perm_rows, qor, is_best,
+                   dur) -> None:
+        """Append one archive row; `tech` records the proposing technique
+        (the reference stores the requestor per Result,
+        resultsdb/models.py:234-300, powering post-hoc attribution)."""
         if self._archive_f is None:
             return
-        rec = {"gid": self.gid - 1, "time": round(dur, 6), "cfg": cfg,
+        rec = {"gid": gid, "tech": tech, "time": round(dur, 6), "cfg": cfg,
                "u": [float(x) for x in u_row],
                "perms": [[int(i) for i in p] for p in perm_rows],
                "qor": float(qor), "best": bool(is_best)}
@@ -271,9 +335,25 @@ class Tuner:
             self._archive_f.flush()
 
     # ------------------------------------------------------------------
-    def step(self) -> StepStats:
-        """One acquisition step: choose arm -> propose batch -> dedup ->
-        evaluate novel -> observe + credit."""
+    @staticmethod
+    def _pack_hashes(hashes) -> np.ndarray:
+        """[B, 2] uint32 device hash pairs -> [B] python-int-safe uint64."""
+        hs = np.asarray(hashes).astype(np.uint64)
+        return (hs[:, 0] << np.uint64(32)) | hs[:, 1]
+
+    def _mask_pending(self, hashes, novel):
+        """Drop candidates whose hash is already out for evaluation."""
+        novel_np = np.array(novel)  # writable copy: filters mutate it
+        if self._pending:
+            packed = self._pack_hashes(hashes)
+            pend = np.fromiter(self._pending, np.uint64,
+                               len(self._pending))
+            novel_np = novel_np & ~np.isin(packed, pend)
+        return novel_np, int(novel_np.sum())
+
+    def _acquire(self) -> _Ticket:
+        """Choose arm -> propose batch -> dedup (history + in-batch +
+        pending) -> surrogate prune; returns the open ticket."""
         order = (self.root.select_order()
                  if isinstance(self.root, MetaTechnique) else [self.root])
         order = [t for t in order if t.name in self._tstates]
@@ -285,13 +365,13 @@ class Tuner:
                 self._tstates[t.name], k, self.best)
             hashes, found, known, src, novel = self._dedup(
                 self.hist_state, cands)
-            n_novel = int(novel.sum())
+            novel_np, n_novel = self._mask_pending(hashes, novel)
             if n_novel > 0 or chosen is None:
-                chosen = (t, tstate, cands, hashes, found, known, src, novel,
+                chosen = (t, tstate, cands, hashes, known, src, novel_np,
                           n_novel)
             if n_novel > 0:
                 break
-        t, tstate, cands, hashes, found, known, src, novel, n_novel = chosen
+        t, tstate, cands, hashes, known, src, novel_np, n_novel = chosen
 
         injected = False
         if n_novel == 0:
@@ -307,14 +387,10 @@ class Tuner:
                 cands = self.space.random(k, cands.batch)
                 hashes, found, known, src, novel = self._dedup(
                     self.hist_state, cands)
-                n_novel = int(novel.sum())
+                novel_np, n_novel = self._mask_pending(hashes, novel)
         else:
             self._zero_novel_streak = 0
 
-        novel_np = np.asarray(novel)
-        src_np = np.asarray(src)
-        qor_np = np.asarray(known, np.float32).copy()  # history dups served
-        evaluated = 0
         pruned = 0
         if n_novel and self.surrogate is not None and not injected:
             keep = self.surrogate.keep_mask(cands)
@@ -322,55 +398,165 @@ class Tuner:
                 pruned = int((novel_np & ~keep).sum())
                 if pruned:
                     # rejected without evaluation (multivoting prune,
-                    # api.py:307-326): +inf to the technique, NOT archived,
-                    # NOT inserted into history (may be re-proposed and
-                    # re-judged after a refit)
-                    novel_np = novel_np & keep
-                    novel = jnp.asarray(novel_np)
+                    # api.py:307-326): NOT archived, NOT inserted into
+                    # history (may be re-proposed after a refit)
+                    novel_np = novel_np & np.asarray(keep)
                     n_novel = int(novel_np.sum())
                     self.pruned_total += pruned
-        if n_novel:
-            idx = np.nonzero(novel_np)[0]
-            sub = cands[jnp.asarray(idx)]
+
+        name = "random" if injected else t.name
+        tk = _Ticket(t, name, tstate, cands, hashes,
+                     np.asarray(known, np.float32).copy(), np.asarray(src),
+                     novel_np, injected, pruned)
+        self._open_ticket(tk)
+        return tk
+
+    def _open_ticket(self, tk: _Ticket) -> None:
+        """Materialize trials for a ticket's novel rows (after the
+        optional ut.rule config filter) and register them pending."""
+        if tk.novel_np.any():
+            idx = np.nonzero(tk.novel_np)[0]
+            sub = tk.cands[jnp.asarray(idx)]
             cfgs = self.space.to_configs(sub)
-            t0 = time.time()
-            vals = np.asarray(self.objective(cfgs), np.float64).reshape(-1)
-            dur = (time.time() - t0) / max(1, len(cfgs))
-            # engine minimizes; failures are +inf in ENGINE orientation
-            # (sign applies to valid values only, else sense='max' would
-            # turn a failure into an unbeatable -inf best)
-            qor_np[idx] = np.where(np.isfinite(vals), self.sign * vals,
-                                   np.inf)
-            evaluated = len(idx)
-            u_np = np.asarray(sub.u)
-            perms_np = [np.asarray(p) for p in sub.perms]
-            running = float(self.best.qor)
-            for j, cfg in enumerate(cfgs):
-                q_int = float(qor_np[idx[j]])
-                is_best = q_int < running
-                running = min(running, q_int)
-                self._log_trial(cfg, u_np[j], [p[j] for p in perms_np],
-                                self.sign * q_int, is_best, dur)
-                self.trace.append(self.sign * running)
-            self.evals += evaluated
-            if self.surrogate is not None:
-                self.surrogate.observe(
-                    np.asarray(self.space.features(sub)), qor_np[idx])
-                self.surrogate.maybe_refit()
+            if self.config_filter is not None:
+                keep = np.asarray([bool(self.config_filter(c))
+                                   for c in cfgs])
+                if not keep.all():
+                    self.filtered_total += int((~keep).sum())
+                    tk.novel_np[idx[~keep]] = False
+                    idx = idx[keep]
+                    cfgs = [c for c, k in zip(cfgs, keep) if k]
+                    sub = (tk.cands[jnp.asarray(idx)] if len(idx)
+                           else None)
+            if len(idx):
+                tk.u_np = np.asarray(sub.u)
+                tk.perms_np = [np.asarray(p) for p in sub.perms]
+                packed = self._pack_hashes(tk.hashes)
+                for j, (row, cfg) in enumerate(zip(idx, cfgs)):
+                    tk.trials.append(Trial(self.gid, cfg, tk, j, int(row)))
+                    self.gid += 1
+                    self._pending.add(int(packed[row]))
+        tk.remaining = len(tk.trials)
+        st = self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])
+        st[0] += 1
+        st[1] += len(tk.trials)
+
+    def inject(self, cfgs: Sequence[Dict[str, Any]],
+               source: str = "seed") -> List[Trial]:
+        """Open a ticket for externally-proposed configs (user models via
+        @ut.model, seed/default configs — the reference's technique
+        'seed' rows, api.py:341-363).  Injected tickets never touch
+        technique states or bandit credit; resolve the returned trials
+        via tell()."""
+        cands = self.space.from_configs(list(cfgs))
+        hashes, found, known, src, novel = self._dedup(
+            self.hist_state, cands)
+        novel_np, _ = self._mask_pending(hashes, novel)
+        tk = _Ticket(None, source, None, cands, hashes,
+                     np.asarray(known, np.float32).copy(),
+                     np.asarray(src), novel_np, injected=True, pruned=0)
+        self._open_ticket(tk)
+        if not tk.trials:
+            self._finalize(tk)  # all dups: serve + commit immediately
+            return []
+        return tk.trials
+
+    # ------------------------------------------------------------------
+    # ask/tell: the externally-paced surface (the reference's OpenTuner
+    # slave API, opentuner/api.py:18-53 get_next_desired_result /
+    # report_result), batched.
+    def ask(self, min_trials: int = 1, max_attempts: int = 8) -> List[Trial]:
+        """Propose >= min_trials hash-novel trials for external
+        evaluation (fewer only if the space saturates)."""
+        trials: List[Trial] = []
+        for _ in range(max_attempts):
+            tk = self._acquire()
+            if tk.trials:
+                trials.extend(tk.trials)
+            else:
+                self._finalize(tk)  # serve dups / credit immediately
+            if len(trials) >= min_trials:
+                break
+        return trials
+
+    def tell(self, trial: Trial, qor: Optional[float],
+             dur: float = 0.0) -> Optional[StepStats]:
+        """Report a trial's USER-oriented QoR (None/NaN/inf = failure).
+        Returns StepStats when the trial's whole ticket resolves."""
+        if trial.qor is not None or trial.cancelled:
+            raise ValueError(f"trial gid={trial.gid} already resolved")
+        v = float("nan") if qor is None else float(qor)
+        # engine minimizes; failures are +inf in ENGINE orientation
+        # (sign applies to valid values only, else sense='max' would
+        # turn a failure into an unbeatable -inf best)
+        trial.qor = self.sign * v if math.isfinite(v) else float("inf")
+        trial.dur = dur
+        tk = trial.ticket
+        tk.remaining -= 1
+        if tk.remaining == 0:
+            return self._finalize(tk)
+        return None
+
+    def cancel(self, trial: Trial) -> Optional[StepStats]:
+        """Withdraw an un-told trial (e.g. the run limit was reached
+        before it launched): no archive row, no history insert, no eval
+        count — the config may be re-proposed later."""
+        if trial.qor is not None or trial.cancelled:
+            raise ValueError(f"trial gid={trial.gid} already resolved")
+        trial.cancelled = True
+        tk = trial.ticket
+        tk.remaining -= 1
+        if tk.remaining == 0:
+            return self._finalize(tk)
+        return None
+
+    def _finalize(self, tk: _Ticket) -> StepStats:
+        """Commit a completed ticket: history insert, best update,
+        archive rows, technique observe + bandit credit."""
+        qor_np = tk.known  # history dups served their recorded result
+        packed = self._pack_hashes(tk.hashes)
+        live = [tr for tr in tk.trials if not tr.cancelled]
+        for tr in tk.trials:
+            self._pending.discard(int(packed[tr.row]))
+            if tr.cancelled:
+                tk.novel_np[tr.row] = False  # never entered history
+            else:
+                qor_np[tr.row] = tr.qor
+        evaluated = len(live)
+        if evaluated and self.surrogate is not None:
+            idx = jnp.asarray([tr.row for tr in live])
+            self.surrogate.observe(
+                np.asarray(self.space.features(tk.cands[idx])),
+                qor_np[np.asarray(idx)])
+            self.surrogate.maybe_refit()
         # in-batch duplicates copy their source row's result
-        qor_np = qor_np[src_np]
-        qor = jnp.asarray(qor_np)
+        qor = jnp.asarray(qor_np[tk.src])
 
         prev = float(self.best.qor)
         self.hist_state, self.best = self._commit(
-            self.hist_state, self.best, hashes, cands, qor, novel)
+            self.hist_state, self.best, tk.hashes, tk.cands, qor,
+            jnp.asarray(tk.novel_np))
         new = float(self.best.qor)
         was_new_best = new < prev
-        if not injected:
-            self._tstates[t.name] = self._observe_jit[t.name](
-                tstate, cands, qor, self.best)
+
+        running = prev
+        for tr in live:
+            is_best = tr.qor < running
+            running = min(running, tr.qor)
+            self._log_trial(tr.gid, tk.arm_name, tr.config,
+                            tk.u_np[tr.slot],
+                            [p[tr.slot] for p in tk.perms_np],
+                            self.sign * tr.qor, is_best, tr.dur)
+            self.trace.append(self.sign * running)
+        self.evals += evaluated
+
+        if not tk.injected:
+            self._tstates[tk.arm.name] = self._observe_jit[tk.arm.name](
+                tk.tstate, tk.cands, qor, self.best)
             if isinstance(self.root, MetaTechnique):
-                self.root.credit(t.name, was_new_best)
+                self.root.credit(tk.arm.name, was_new_best)
+        if was_new_best:
+            self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])[2] += 1
         if self.evals > self.history.capacity and not self._cap_warned:
             self._cap_warned = True
             import warnings
@@ -380,9 +566,27 @@ class Tuner:
                 f"Tuner(capacity=...)")
         self.steps += 1
         self._flush_archive()
-        return StepStats(self.steps, "random" if injected else t.name,
-                         cands.batch, evaluated, self.sign * new,
-                         was_new_best, pruned)
+        return StepStats(self.steps, tk.arm_name, tk.cands.batch, evaluated,
+                         self.sign * new, was_new_best, tk.pruned)
+
+    def step(self) -> StepStats:
+        """One synchronous acquisition step: acquire -> evaluate novel
+        via the in-process objective -> finalize."""
+        if self.objective is None:
+            raise RuntimeError(
+                "Tuner has no in-process objective: drive it externally "
+                "via ask()/tell() instead of step()/run()")
+        tk = self._acquire()
+        if not tk.trials:
+            return self._finalize(tk)
+        cfgs = [tr.config for tr in tk.trials]
+        t0 = time.time()
+        vals = np.asarray(self.objective(cfgs), np.float64).reshape(-1)
+        dur = (time.time() - t0) / max(1, len(cfgs))
+        stats = None
+        for tr, v in zip(tk.trials, vals):
+            stats = self.tell(tr, float(v), dur)
+        return stats
 
     # ------------------------------------------------------------------
     def run(self, test_limit: int = 5000,

@@ -1,0 +1,18 @@
+"""The black-box evaluation plane: subprocess measurement, sandboxed
+worker pools, and the program-tuning controller that drives the on-device
+Tuner through its ask/tell surface.
+
+Replaces the reference's Ray-actor execution layer
+(`/root/reference/python/uptune/api.py:813-910` RunProgram,
+`api.py:399-594` async_execute, `src/single_stage.py:13-82`) with a
+dependency-free subprocess pool: the search side runs as batched XLA
+programs on the TPU, so the host side only needs cheap process
+supervision, not a distributed object store.
+"""
+from .measure import call_program
+from .pool import WorkerPool
+from .controller import ProgramTuner
+from .space_io import space_from_params, stage_spaces, default_config
+
+__all__ = ["call_program", "WorkerPool", "ProgramTuner",
+           "space_from_params", "stage_spaces", "default_config"]

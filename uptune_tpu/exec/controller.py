@@ -1,0 +1,297 @@
+"""ProgramTuner: end-to-end black-box tuning of an external program.
+
+The TPU-native re-design of the reference's controller stack
+(`/root/reference/python/uptune/api.py:399-594` async_execute +
+`src/async_task_scheduler.py:20-52` analysis +
+`src/single_stage.py:13-82` single-stage run builder):
+
+1. ANALYSIS: run the program once with UT_BEFORE_RUN_PROFILE=On; it
+   records its search space (`ut.params.json`) and default QoR.
+2. Build the device Space and a Tuner whose proposal side (techniques,
+   bandit, dedup, surrogate prune) runs as batched XLA programs.
+3. Async evaluation: keep a WorkerPool of subprocess slots busy from the
+   Tuner's ask() queue; tell() results back as they arrive (the free-list
+   semantics of api.py:458-554) with timeout kill + dead-worker
+   replacement; honor @ut.rule config filters, @ut.constraint QoR
+   checks, and @ut.model host proposal sources.
+4. Persist best.json on every improvement (api.py:146-149) and the jsonl
+   trial archive for resume.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api.constraint import REGISTRY
+from ..api.session import settings, write_best
+from ..api.state import DEFAULT_QOR_FILE, PARAMS_FILE
+from ..api.tuner import registered_models
+from ..driver.driver import Trial, Tuner, TuneResult
+from .measure import call_program
+from .pool import WorkerPool
+from .space_io import default_config, space_from_params
+
+log = logging.getLogger("uptune_tpu")
+
+
+class AnalysisError(RuntimeError):
+    pass
+
+
+class ProgramTuner:
+    """Tune an external program invocation over its declared space.
+
+    Parameters mirror the reference's CLI/settings layer (api.py:24-48);
+    any left as None falls back to ut.config() session settings.
+    """
+
+    def __init__(self, command, work_dir: Optional[str] = None, *,
+                 parallel: Optional[int] = None,
+                 test_limit: Optional[int] = None,
+                 runtime_limit: Optional[float] = None,
+                 timeout: Optional[float] = None,
+                 technique=None, seed: Optional[int] = None,
+                 params_file: Optional[str] = None,
+                 archive: Optional[str] = None, resume: bool = False,
+                 surrogate=None, env: Optional[Dict[str, str]] = None,
+                 sandbox: bool = True,
+                 status_interval: Optional[int] = None,
+                 template=None):
+        # template: a TemplateProgram (non-intrusive mode) — the space
+        # comes from its annotations and each trial renders its own copy
+        # of the source into the sandbox before launch
+        self.template = template
+        if template is not None and isinstance(command, (list, tuple)):
+            # trials must execute the per-sandbox RENDERED copy, so any
+            # absolute reference to the annotated source becomes relative
+            # to the trial's cwd (its sandbox)
+            tpath = os.path.abspath(template.path)
+            command = [os.path.basename(c)
+                       if isinstance(c, str) and os.path.abspath(c) == tpath
+                       else c for c in command]
+        self.command = command
+        self.work_dir = os.path.abspath(work_dir or os.getcwd())
+        self.parallel = int(parallel if parallel is not None
+                            else settings["parallel-factor"])
+        self.test_limit = int(test_limit if test_limit is not None
+                              else settings["test-limit"])
+        self.runtime_limit = (runtime_limit if runtime_limit is not None
+                              else settings["runtime-limit"])
+        self.timeout = (timeout if timeout is not None
+                        else settings["timeout"])
+        self.interval = float(settings["async-interval"])
+        self.technique = (technique if technique is not None
+                          else settings["technique"])
+        self.seed = int(seed if seed is not None else settings["seed"])
+        self.params_file = params_file
+        self.archive = archive if archive is not None else os.path.join(
+            self.work_dir, "ut.archive.jsonl")
+        self.resume = resume
+        self.surrogate = surrogate
+        self.env_extra = dict(env or {})
+        self.use_sandbox = sandbox
+        self.status_interval = (status_interval if status_interval
+                                is not None else max(1, self.parallel))
+
+        self.params: Optional[List[List[Dict[str, Any]]]] = None
+        self.default_qor: Optional[float] = None
+        self.sense = "min"
+        self.tuner: Optional[Tuner] = None
+        self.pool: Optional[WorkerPool] = None
+        self.stage = 0
+        self._results_seen = 0
+        self._host_history: List[Tuple[Dict[str, Any], float]] = []
+
+    # ------------------------------------------------------------------
+    def analyze(self, force: bool = False) -> List[List[Dict[str, Any]]]:
+        """Space discovery: reuse an existing ut.params.json (the
+        reference's --params short-circuit, async_task_scheduler.py:21-32)
+        or run the profiling subprocess."""
+        if self.template is not None:
+            # template mode: the space comes from the annotations; run the
+            # default-rendered program once for the default QoR + sense
+            self.params = [self.template.records]
+            self.template.write_params(
+                os.path.join(self.work_dir, PARAMS_FILE))
+            name = os.path.basename(self.template.path)
+            dflt = os.path.join(self.work_dir, name)
+            if os.path.abspath(dflt) != os.path.abspath(
+                    self.template.path):
+                self.template.render_to(dflt)
+            env = dict(os.environ)
+            env.update(self.env_extra)
+            env.pop("UT_TUNE_START", None)
+            env.update({"UT_BEFORE_RUN_PROFILE": "On",
+                        "UT_WORK_DIR": self.work_dir})
+            call_program(self.command, limit=self.runtime_limit, env=env,
+                         cwd=self.work_dir)
+            self._read_default_qor()
+            return self.params
+
+        path = self.params_file or os.path.join(self.work_dir, PARAMS_FILE)
+        if not force and os.path.isfile(path):
+            with open(path) as f:
+                self.params = json.load(f)
+        else:
+            env = dict(os.environ)
+            env.update(self.env_extra)
+            env.pop("UT_TUNE_START", None)
+            env.update({"UT_BEFORE_RUN_PROFILE": "On",
+                        "UT_WORK_DIR": self.work_dir})
+            res = call_program(self.command, limit=self.runtime_limit,
+                               env=env, cwd=self.work_dir)
+            ppath = os.path.join(self.work_dir, PARAMS_FILE)
+            if res["returncode"] != 0 or not os.path.isfile(ppath):
+                raise AnalysisError(
+                    f"analysis run failed (rc={res['returncode']}, "
+                    f"timeout={res['timeout']}): "
+                    f"{res['stderr'].strip()[-500:]}")
+            with open(ppath) as f:
+                self.params = json.load(f)
+        if not self.params or not any(self.params):
+            raise AnalysisError("analysis recorded no tunable parameters")
+        self._read_default_qor()
+        return self.params
+
+    def _read_default_qor(self) -> None:
+        dq_path = os.path.join(self.work_dir, DEFAULT_QOR_FILE)
+        if os.path.isfile(dq_path):
+            try:
+                with open(dq_path) as f:
+                    dq = json.load(f)
+                self.default_qor = float(dq["qor"])
+                self.sense = dq.get("trend", "min")
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                pass
+
+    # ------------------------------------------------------------------
+    def _make_tuner(self, space) -> Tuner:
+        filt = (REGISTRY.check_config if REGISTRY.rules else None)
+        return Tuner(space, None, technique=self.technique,
+                     seed=self.seed, sense=self.sense,
+                     archive=self.archive, resume=self.resume,
+                     surrogate=self.surrogate, config_filter=filt)
+
+    def _maybe_new_best(self, stats) -> None:
+        if stats is not None and stats.was_new_best:
+            res = self.tuner.result()
+            write_best(res.best_config, res.best_qor,
+                       work_dir=self.work_dir)
+            log.info("[ut] new best qor=%.6g after %d evals",
+                     res.best_qor, res.evals)
+
+    def _status(self, last_qor: Optional[float]) -> None:
+        self._results_seen += 1
+        if self._results_seen % self.status_interval:
+            return
+        res = self.tuner.result()
+        lw = "fail" if last_qor is None else f"{last_qor:.6g}"
+        log.info("[ut] evals=%d best(GB)=%.6g last(LW)=%s pending=%d "
+                 "replaced=%d", res.evals, res.best_qor, lw,
+                 self.pool.busy_count, self.pool.replaced)
+
+    def _host_proposals(self, space) -> List[Trial]:
+        """Ask @ut.model proposal sources for one config each."""
+        trials: List[Trial] = []
+        for fn in registered_models():
+            try:
+                cfg = fn(list(self._host_history), space)
+            except Exception as e:  # user code: isolate failures
+                log.warning("[ut] custom model %s failed: %s",
+                            getattr(fn, "_ut_model_name", fn), e)
+                continue
+            if isinstance(cfg, dict):
+                trials.extend(self.tuner.inject(
+                    [cfg], source=getattr(fn, "_ut_model_name", "model")))
+        return trials
+
+    # ------------------------------------------------------------------
+    def run(self, test_limit: Optional[int] = None,
+            time_limit: Optional[float] = None) -> TuneResult:
+        """Tune end-to-end; returns the Tuner's TuneResult."""
+        if self.params is None:
+            self.analyze()
+        limit = int(test_limit if test_limit is not None
+                    else self.test_limit)
+        wall_limit = (time_limit if time_limit is not None
+                      else self.timeout)
+        records = self.params[self.stage]
+        space = space_from_params(records)
+        self.tuner = tuner = self._make_tuner(space)
+
+        queue: collections.deque = collections.deque()
+        # seed trial: the program's declared defaults; its QoR was already
+        # measured by the profiling run, so tell() it without a subprocess
+        seed_trials = tuner.inject([default_config(records)], "seed")
+        dq = self.default_qor
+        if dq is not None and REGISTRY.constraints and \
+                not REGISTRY.check_qor(dq, default_config(records)):
+            dq = None   # the default itself violates a QoR constraint
+        if seed_trials and dq is not None:
+            for tr in seed_trials:
+                self._maybe_new_best(tuner.tell(tr, dq))
+        else:
+            queue.extend(seed_trials)
+        queue.extend(self._host_proposals(space))
+
+        pre_launch = None
+        if self.template is not None:
+            name = os.path.basename(self.template.path)
+            tpl = self.template
+
+            def pre_launch(sb, index, trial):
+                tpl.render_to(os.path.join(sb, name), trial.config)
+
+        t0 = time.time()
+        dry_asks = 0
+        with WorkerPool(self.command, self.work_dir, self.parallel,
+                        runtime_limit=self.runtime_limit,
+                        env=self.env_extra,
+                        sandbox=self.use_sandbox,
+                        pre_launch=pre_launch) as pool:
+            self.pool = pool
+            while True:
+                outstanding = pool.busy_count + len(queue)
+                if (tuner.evals + outstanding < limit
+                        and len(queue) < len(pool.free_slots())
+                        and dry_asks < 8):
+                    want = len(pool.free_slots()) - len(queue)
+                    asked = tuner.ask(min_trials=want)
+                    queue.extend(asked)
+                    dry_asks = 0 if asked else dry_asks + 1
+                while queue and pool.free_slots() and \
+                        tuner.evals + pool.busy_count < limit:
+                    pool.submit(queue.popleft(), stage=self.stage)
+                if pool.busy_count == 0:
+                    if tuner.evals >= limit:
+                        break
+                    if not queue and dry_asks >= 8:
+                        break  # space saturated: nothing left to propose
+                for trial, qor, dur, info in pool.poll(self.interval):
+                    if qor is not None and REGISTRY.constraints and \
+                            not REGISTRY.check_qor(qor, trial.config):
+                        qor = None  # constraint violation = failure
+                    stats = tuner.tell(trial, qor, dur)
+                    if qor is not None:
+                        self._host_history.append((trial.config, qor))
+                    self._maybe_new_best(stats)
+                    self._status(qor)
+                if wall_limit and time.time() - t0 > wall_limit:
+                    for trial, qor, dur, info in pool.drain(
+                            timeout=self.runtime_limit):
+                        tuner.tell(trial, qor, dur)
+                    break
+            # withdraw trials still queued (never launched): no archive
+            # rows, no failure penalty — the limit simply arrived first
+            while queue:
+                tuner.cancel(queue.popleft())
+        res = tuner.result()
+        if res.best_config:
+            write_best(res.best_config, res.best_qor,
+                       work_dir=self.work_dir)
+        tuner.close()
+        return res

@@ -1,0 +1,61 @@
+"""Bridge between the JSON param records written by the intrusive API
+(`ut.params.json`, see uptune_tpu/api/state.py) and the device-side
+`Space`.
+
+The reference builds an OpenTuner ConfigurationManipulator from the same
+records (`/root/reference/python/uptune/api.py:179-199` create_params);
+here each record becomes one typed ParamSpec lane of a flat-encoded
+Space.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+from ..space import params as P
+from ..space.spec import Space
+
+
+def _spec_from_record(rec: Dict[str, Any]) -> P.ParamSpec:
+    name, kind = rec["name"], rec["type"]
+    if kind == "int":
+        return P.IntParam(name, int(rec["lo"]), int(rec["hi"]))
+    if kind == "float":
+        return P.FloatParam(name, float(rec["lo"]), float(rec["hi"]))
+    if kind == "bool":
+        return P.BoolParam(name)
+    if kind == "enum":
+        opts = rec["options"]
+        # JSON round-trips lists; options must be hashable for codecs
+        return P.EnumParam(name, tuple(
+            tuple(o) if isinstance(o, list) else o for o in opts))
+    if kind == "perm":
+        return P.PermParam(name, tuple(
+            tuple(o) if isinstance(o, list) else o for o in rec["items"]))
+    if kind == "log_int":
+        return P.LogIntParam(name, int(rec["lo"]), int(rec["hi"]))
+    if kind == "log_float":
+        return P.LogFloatParam(name, float(rec["lo"]), float(rec["hi"]))
+    if kind == "pow2":
+        return P.Pow2Param(name, int(rec["lo"]), int(rec["hi"]))
+    raise ValueError(f"unknown param record type {kind!r} for {name!r}")
+
+
+def space_from_params(records: Sequence[Dict[str, Any]]) -> Space:
+    """Build a Space from ONE stage's param records."""
+    return Space([_spec_from_record(r) for r in records])
+
+
+def stage_spaces(all_records: Sequence[Sequence[Dict[str, Any]]]
+                 ) -> List[Space]:
+    """Build one Space per stage from the full ut.params.json payload."""
+    return [space_from_params(stage) for stage in all_records]
+
+
+def default_config(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The program's declared defaults as a config dict (the seed trial —
+    the reference captures its QoR in the profiling run)."""
+    out = {}
+    for r in records:
+        v = r.get("default")
+        out[r["name"]] = list(v) if r["type"] == "perm" else v
+    return out
