@@ -1,0 +1,33 @@
+// Demo workload for end-to-end C++ tuning (the gcc-options shape in
+// miniature: block size + unroll + opt level + a continuous knob).
+//
+// Deterministic synthetic cost surface with a unique optimum at
+// block=32, alpha=0.8, unroll=true, opt="O3" (cost 0), so tests can
+// assert convergence without timing noise.  Tuned through the same
+// subprocess plane as Python workloads (uptune_tpu/exec/controller.py);
+// the reference's equivalent demo never existed (src/uptune.h was a
+// skeleton).
+
+#include <cstdio>
+#include <string>
+
+#include "uptune/uptune.hpp"
+
+int main() {
+  int block = uptune::tune(16, {1, 64}, "block");
+  double alpha = uptune::tune(0.5, std::make_pair(0.0, 1.0), "alpha");
+  bool unroll = uptune::tune(false, "unroll");
+  std::string opt = uptune::tune("O1", {"O0", "O1", "O2", "O3"}, "opt");
+
+  double cost = (block - 32) * (block - 32) / 64.0 +
+                (alpha - 0.8) * (alpha - 0.8) * 10.0 +
+                (unroll ? 0.0 : 1.5);
+  if (opt == "O0") cost += 2.0;
+  else if (opt == "O1") cost += 1.0;
+  else if (opt == "O2") cost += 0.5;
+
+  uptune::target(cost, "min");
+  std::printf("block=%d alpha=%.3f unroll=%d opt=%s cost=%.4f\n", block,
+              alpha, unroll ? 1 : 0, opt.c_str(), cost);
+  return 0;
+}

@@ -1,0 +1,498 @@
+// uptune C++ client — the intrusive tuning API for native workloads.
+//
+// The complete counterpart of the reference's unfinished header
+// (/root/reference/src/uptune.h:14-47, whose ANALYSIS branch was a
+// skeleton and whose TUNE branch was absent): this client implements the
+// whole four-mode env/JSON protocol of uptune_tpu/api/state.py, so a C++
+// program can be tuned by the same controller as a Python one —
+//
+//   ANALYSIS (UT_BEFORE_RUN_PROFILE): uptune::tune() records the search
+//     space; uptune::target() flushes ut.params.json + ut.default_qor.json
+//     and closes the stage.
+//   TUNE (UT_TUNE_START): tune() serves values from the proposal published
+//     at configs/ut.dr_stage{S}_index{I}.json — name-first lookup with the
+//     positional-counter fallback (template/types.py:132-134 semantics);
+//     target() appends [index, val, trend] to ut.qor_stage{S}.json (and
+//     acts as the multi-stage breakpoint: exit(0) at the tuned stage).
+//   BEST (BEST): tune() serves values from best.json.
+//   DEFAULT (no env): tune() returns its origin value.
+//
+// Header-only, C++11, no dependencies beyond the bundled json.hpp.
+#pragma once
+
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "json.hpp"
+
+namespace uptune {
+
+enum class Mode { Default, Analysis, Tune, Best };
+
+namespace detail {
+
+inline bool truthy(const char* v) {
+  if (v == nullptr) return false;
+  std::string s(v);
+  for (auto& c : s) c = static_cast<char>(std::tolower(c));
+  return !(s.empty() || s == "0" || s == "false" || s == "off");
+}
+
+inline std::string read_file(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::ostringstream os;
+  os << f.rdbuf();
+  return os.str();
+}
+
+inline void write_file(const std::string& path, const std::string& text) {
+  std::ofstream f(path);
+  if (!f) throw std::runtime_error("cannot write " + path);
+  f << text;
+}
+
+// Per-process protocol state (mirror of api/state.py _ProtocolState).
+class Client {
+ public:
+  static Client& instance() {
+    static Client c;
+    return c;
+  }
+
+  Mode mode() const { return mode_; }
+  int index() const { return index_; }
+  int stage() const { return stage_; }
+  long long global_id() const { return global_id_; }
+  const std::string& work_dir() const { return work_dir_; }
+
+  // ---------------------------------------------------------- ANALYSIS
+  void record_param(json::Object rec) {
+    while (recorded_.size() <= static_cast<size_t>(cur_stage_))
+      recorded_.push_back(json::Array{});
+    auto& stage = recorded_[cur_stage_].as_array();
+    if (!rec.count("name") || rec["name"].as_string().empty()) {
+      rec["name"] = "v" + std::to_string(cur_stage_) + "_" +
+                    std::to_string(stage.size());
+    }
+    const std::string& name = rec["name"].as_string();
+    for (const auto& st : recorded_)
+      for (const auto& r : st.as_array())
+        if (r.at("name").as_string() == name)
+          throw std::runtime_error("duplicate tunable parameter name: " +
+                                   name);
+    stage.push_back(json::Value(std::move(rec)));
+  }
+
+  void flush_params() {
+    write_file(work_dir_ + "/ut.params.json",
+               json::Value(recorded_).dump());
+  }
+
+  size_t recorded_stages() const { return recorded_.size(); }
+
+  // -------------------------------------------------------------- TUNE
+  // Serve the value for the next tune() call: name-first, positional
+  // fallback against ut.params.json (state.py next_value).
+  json::Value next_value(const std::string& name,
+                         const json::Value& dflt) {
+    if (!loaded_) {
+      loaded_ = true;
+      try {
+        if (mode_ == Mode::Best) {
+          load_best();
+        } else {
+          load_proposal();
+        }
+      } catch (const std::exception&) {
+        proposal_ok_ = false;  // no/bad published config: run as default
+      }
+    }
+    if (!proposal_ok_) {
+      ++count_;
+      return dflt;
+    }
+    std::string key;
+    if (!name.empty() && proposal_.count(name)) {
+      key = name;
+    } else if (params_meta_.is_array() &&
+               static_cast<size_t>(cur_stage_) < params_meta_.size()) {
+      const auto& stage_params = params_meta_.at(cur_stage_).as_array();
+      if (static_cast<size_t>(count_) < stage_params.size())
+        key = stage_params[count_].at("name").as_string();
+    }
+    ++count_;
+    if (key.empty() || !proposal_.count(key)) return dflt;
+    return proposal_.at(key);
+  }
+
+  size_t n_stages() const {
+    if (params_meta_.is_array() && params_meta_.size() > 0)
+      return params_meta_.size();
+    return recorded_.empty() ? 1 : recorded_.size();
+  }
+
+  // --------------------------------------------------------------- QoR
+  void write_qor_row(double val, const std::string& trend) {
+    std::string path = work_dir_ + "/ut.qor_stage" +
+                       std::to_string(cur_stage_) + ".json";
+    json::Array rows;
+    try {
+      json::Value prev = json::parse(read_file(path));
+      if (prev.is_array()) rows = prev.as_array();
+    } catch (const std::exception&) {
+    }
+    rows.push_back(json::Value(json::Array{
+        json::Value(index_), json::Value(val), json::Value(trend)}));
+    write_file(path, json::Value(rows).dump());
+  }
+
+  void write_default_qor(double val, const std::string& trend) {
+    json::Object o;
+    o["qor"] = val;
+    o["trend"] = trend;
+    o["stage"] = cur_stage_;
+    write_file(work_dir_ + "/ut.default_qor.json",
+               json::Value(std::move(o)).dump());
+  }
+
+  // target() bookkeeping (report.py target): returns true when the
+  // caller must exit(0) — the multi-stage TUNE breakpoint.
+  bool on_target(double val, const std::string& trend) {
+    if (mode_ == Mode::Analysis) {
+      flush_params();
+      write_default_qor(val, trend);
+      ++cur_stage_;
+      count_ = 0;
+      return false;
+    }
+    if (mode_ == Mode::Tune) {
+      if (n_stages() <= 1) {
+        write_qor_row(val, trend);
+        return false;
+      }
+      if (cur_stage_ == stage_) {
+        write_qor_row(val, trend);
+        return true;  // breakpoint: the tuned stage is done
+      }
+      if (cur_stage_ > stage_)
+        throw std::runtime_error("breakpoint past the tuned stage");
+      ++cur_stage_;
+      count_ = 0;
+      return false;
+    }
+    if (mode_ == Mode::Best) {
+      ++cur_stage_;
+      count_ = 0;
+    }
+    return false;
+  }
+
+ private:
+  Client() {
+    const char* wd = std::getenv("UT_WORK_DIR");
+    work_dir_ = wd != nullptr && *wd ? wd : ".";
+    if (truthy(std::getenv("UT_BEFORE_RUN_PROFILE"))) {
+      mode_ = Mode::Analysis;
+    } else if (truthy(std::getenv("UT_TUNE_START"))) {
+      mode_ = Mode::Tune;
+    } else if (truthy(std::getenv("BEST"))) {
+      mode_ = Mode::Best;
+    } else {
+      mode_ = Mode::Default;
+    }
+    const char* s = std::getenv("UT_CURR_STAGE");
+    stage_ = s != nullptr ? std::atoi(s) : 0;
+    const char* i = std::getenv("UT_CURR_INDEX");
+    index_ = i != nullptr ? std::atoi(i) : 0;
+    const char* g = std::getenv("UT_GLOBAL_ID");
+    global_id_ = g != nullptr ? std::atoll(g) : 0;
+  }
+
+  void load_params_meta() {
+    try {
+      params_meta_ = json::parse(read_file(work_dir_ + "/ut.params.json"));
+    } catch (const std::exception&) {
+      params_meta_ = json::Value();
+    }
+  }
+
+  void load_proposal() {
+    std::string path = work_dir_ + "/configs/ut.dr_stage" +
+                       std::to_string(stage_) + "_index" +
+                       std::to_string(index_) + ".json";
+    json::Value v = json::parse(read_file(path));
+    proposal_ = v.as_object();
+    load_params_meta();
+    // merge best configs of earlier pipeline stages (state.py:121-127)
+    for (int s = 0; s < stage_; ++s) {
+      try {
+        json::Value prev = json::parse(read_file(
+            work_dir_ + "/configs/" + std::to_string(s) + "-best.json"));
+        for (const auto& kv : prev.as_object())
+          if (!proposal_.count(kv.first)) proposal_[kv.first] = kv.second;
+      } catch (const std::exception&) {
+      }
+    }
+    proposal_ok_ = true;
+  }
+
+  void load_best() {
+    json::Value v = json::parse(read_file(work_dir_ + "/best.json"));
+    if (v.is_object()) {
+      proposal_ = v.contains("config") ? v.at("config").as_object()
+                                       : v.as_object();
+    } else if (v.is_array() && v.size() == 2 && v.at(0).is_object()) {
+      proposal_ = v.at(0).as_object();
+    } else {
+      throw std::runtime_error("unrecognized best.json payload");
+    }
+    load_params_meta();
+    proposal_ok_ = true;
+  }
+
+  Mode mode_;
+  std::string work_dir_;
+  int index_ = 0;
+  int stage_ = 0;
+  long long global_id_ = 0;
+  int cur_stage_ = 0;
+  int count_ = 0;
+  bool loaded_ = false;
+  bool proposal_ok_ = false;
+  json::Array recorded_;     // per-stage arrays of param records
+  json::Object proposal_;
+  json::Value params_meta_;
+};
+
+}  // namespace detail
+
+// ======================================================================
+// Public API
+// ======================================================================
+
+// tune(origin, {lo, hi}[, name]) — integer range parameter.
+template <typename T,
+          typename std::enable_if<std::is_integral<T>::value &&
+                                      !std::is_same<T, bool>::value,
+                                  int>::type = 0>
+T tune(T origin, std::pair<T, T> range, const std::string& name = "") {
+  auto& c = detail::Client::instance();
+  switch (c.mode()) {
+    case Mode::Analysis: {
+      json::Object rec;
+      rec["name"] = name;
+      rec["type"] = "int";
+      rec["default"] = static_cast<long long>(origin);
+      rec["lo"] = static_cast<long long>(range.first);
+      rec["hi"] = static_cast<long long>(range.second);
+      c.record_param(std::move(rec));
+      return origin;
+    }
+    case Mode::Tune:
+    case Mode::Best: {
+      json::Value v = c.next_value(
+          name, json::Value(static_cast<long long>(origin)));
+      return v.is_number() ? static_cast<T>(v.as_int()) : origin;
+    }
+    default:
+      return origin;
+  }
+}
+
+// Reference-style call with a brace range: tune<int>(2, {1, 8})
+// (/root/reference/tests/cpp/test_basic.cc:5-8 treats {lo, hi} as the
+// inclusive range).
+template <typename T,
+          typename std::enable_if<std::is_integral<T>::value &&
+                                      !std::is_same<T, bool>::value,
+                                  int>::type = 0>
+T tune(T origin, std::initializer_list<T> range,
+       const std::string& name = "") {
+  if (range.size() != 2)
+    throw std::invalid_argument("tune: range must be {lo, hi}");
+  auto it = range.begin();
+  T lo = *it++;
+  T hi = *it;
+  return tune(origin, std::make_pair(lo, hi), name);
+}
+
+// tune(origin, {lo, hi}[, name]) — float range parameter.
+template <typename T,
+          typename std::enable_if<std::is_floating_point<T>::value,
+                                  int>::type = 0>
+T tune(T origin, std::pair<T, T> range, const std::string& name = "") {
+  auto& c = detail::Client::instance();
+  switch (c.mode()) {
+    case Mode::Analysis: {
+      json::Object rec;
+      rec["name"] = name;
+      rec["type"] = "float";
+      rec["default"] = static_cast<double>(origin);
+      rec["lo"] = static_cast<double>(range.first);
+      rec["hi"] = static_cast<double>(range.second);
+      c.record_param(std::move(rec));
+      return origin;
+    }
+    case Mode::Tune:
+    case Mode::Best: {
+      json::Value v =
+          c.next_value(name, json::Value(static_cast<double>(origin)));
+      return v.is_number() ? static_cast<T>(v.as_double()) : origin;
+    }
+    default:
+      return origin;
+  }
+}
+
+template <typename T,
+          typename std::enable_if<std::is_floating_point<T>::value,
+                                  int>::type = 0>
+T tune(T origin, std::initializer_list<T> range,
+       const std::string& name = "") {
+  if (range.size() != 2)
+    throw std::invalid_argument("tune: range must be {lo, hi}");
+  auto it = range.begin();
+  T lo = *it++;
+  T hi = *it;
+  return tune(origin, std::make_pair(lo, hi), name);
+}
+
+// tune(origin[, name]) — boolean flag.
+inline bool tune(bool origin, const std::string& name = "") {
+  auto& c = detail::Client::instance();
+  switch (c.mode()) {
+    case Mode::Analysis: {
+      json::Object rec;
+      rec["name"] = name;
+      rec["type"] = "bool";
+      rec["default"] = origin;
+      c.record_param(std::move(rec));
+      return origin;
+    }
+    case Mode::Tune:
+    case Mode::Best: {
+      json::Value v = c.next_value(name, json::Value(origin));
+      if (v.is_bool()) return v.as_bool();
+      if (v.is_number()) return v.as_double() != 0.0;
+      return origin;
+    }
+    default:
+      return origin;
+  }
+}
+
+// tune(origin, options[, name]) — enum over strings.
+inline std::string tune(const std::string& origin,
+                        const std::vector<std::string>& options,
+                        const std::string& name = "") {
+  auto& c = detail::Client::instance();
+  switch (c.mode()) {
+    case Mode::Analysis: {
+      bool found = false;
+      json::Array opts;
+      for (const auto& o : options) {
+        opts.push_back(json::Value(o));
+        if (o == origin) found = true;
+      }
+      if (!found)
+        throw std::invalid_argument("tune: default \"" + origin +
+                                    "\" not in options");
+      json::Object rec;
+      rec["name"] = name;
+      rec["type"] = "enum";
+      rec["default"] = origin;
+      rec["options"] = std::move(opts);
+      c.record_param(std::move(rec));
+      return origin;
+    }
+    case Mode::Tune:
+    case Mode::Best: {
+      json::Value v = c.next_value(name, json::Value(origin));
+      return v.is_string() ? v.as_string() : origin;
+    }
+    default:
+      return origin;
+  }
+}
+
+inline std::string tune(const char* origin,
+                        const std::vector<std::string>& options,
+                        const std::string& name = "") {
+  return tune(std::string(origin), options, name);
+}
+
+// tune_enum(origin, choices[, name]) — enum over numeric choices
+// (distinct from the {lo, hi} range overloads above).
+template <typename T,
+          typename std::enable_if<std::is_arithmetic<T>::value,
+                                  int>::type = 0>
+T tune_enum(T origin, const std::vector<T>& choices,
+            const std::string& name = "") {
+  auto& c = detail::Client::instance();
+  switch (c.mode()) {
+    case Mode::Analysis: {
+      bool found = false;
+      json::Array opts;
+      for (const auto& o : choices) {
+        opts.push_back(json::Value(static_cast<double>(o)));
+        if (o == origin) found = true;
+      }
+      if (!found)
+        throw std::invalid_argument("tune_enum: default not in choices");
+      json::Object rec;
+      rec["name"] = name;
+      rec["type"] = "enum";
+      rec["default"] = static_cast<double>(origin);
+      rec["options"] = std::move(opts);
+      c.record_param(std::move(rec));
+      return origin;
+    }
+    case Mode::Tune:
+    case Mode::Best: {
+      json::Value v =
+          c.next_value(name, json::Value(static_cast<double>(origin)));
+      return v.is_number() ? static_cast<T>(
+                                 std::is_integral<T>::value
+                                     ? static_cast<double>(v.as_int())
+                                     : v.as_double())
+                           : origin;
+    }
+    default:
+      return origin;
+  }
+}
+
+// target(value[, trend]) — report the QoR of this run; in multi-stage
+// TUNE mode this is the stage breakpoint (the process exits 0 when the
+// tuned stage completes, exactly like report.py:69-79).
+inline double target(double value, const std::string& trend = "min") {
+  if (trend != "min" && trend != "max")
+    throw std::invalid_argument("target: trend must be 'min' or 'max'");
+  if (detail::Client::instance().on_target(value, trend)) std::exit(0);
+  return value;
+}
+
+inline Mode mode() { return detail::Client::instance().mode(); }
+
+// Global trial id under tuning; -1 outside a tuning run
+// (report.py:141-145 get_global_id returns 'base' — C++ callers get -1).
+inline long long get_global_id() {
+  return detail::truthy(std::getenv("UT_TUNE_START"))
+             ? detail::Client::instance().global_id()
+             : -1;
+}
+
+// Worker-slot index under tuning; -1 outside a tuning run.
+inline int get_local_id() {
+  return detail::truthy(std::getenv("UT_TUNE_START"))
+             ? detail::Client::instance().index()
+             : -1;
+}
+
+}  // namespace uptune

@@ -1,0 +1,187 @@
+// Unit tests for the uptune C++ client (cpp/include/uptune/uptune.hpp).
+//
+// Mirrors the reference's lone C++ test — default mode returns the origin
+// (/root/reference/tests/cpp/test_basic.cc:5-8) — and adds the tune-mode
+// coverage the reference never wrote: ANALYSIS records the space, TUNE
+// serves published proposals (name-keyed and positional) and writes QoR
+// rows, BEST serves best.json.
+//
+// The protocol mode is fixed per process (env is read once), so the
+// binary re-executes itself once per phase: with no argument it
+// orchestrates; with a phase argument it runs that phase's assertions.
+// Plain asserts — no gtest dependency.
+
+#include <sys/wait.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <fstream>
+#include <string>
+
+#include "uptune/json.hpp"
+#include "uptune/uptune.hpp"
+
+// assert() vanishes under NDEBUG (CMake Release); CHECK never does.
+#define CHECK(cond)                                                      \
+  do {                                                                 \
+    if (!(cond)) {                                                     \
+      std::fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__,       \
+                   __LINE__, #cond);                                   \
+      std::abort();                                                    \
+    }                                                                  \
+  } while (0)
+
+namespace {
+
+std::string g_dir;
+
+std::string read_all(const std::string& path) {
+  std::ifstream f(path);
+  CHECK(f && "missing file");
+  std::string s((std::istreambuf_iterator<char>(f)),
+                std::istreambuf_iterator<char>());
+  return s;
+}
+
+void write_all(const std::string& path, const std::string& text) {
+  std::ofstream f(path);
+  CHECK(f && "cannot write");
+  f << text;
+}
+
+int run_phase(const std::string& self, const std::string& env,
+              const std::string& phase) {
+  std::string cmd = "env " + env + " UT_WORK_DIR=" + g_dir + " " + self +
+                    " " + phase;
+  int rc = std::system(cmd.c_str());
+  return rc == -1 ? -1 : WEXITSTATUS(rc);
+}
+
+// ---------------------------------------------------------------- phases
+
+void phase_default() {
+  CHECK(uptune::tune(2, {1, 8}) == 2);
+  CHECK(uptune::tune(0.5, {0.0, 1.0}) == 0.5);
+  CHECK(uptune::tune(true) == true);
+  CHECK(uptune::tune("a", {"a", "b"}) == "a");
+  CHECK(uptune::tune_enum(4, std::vector<int>{2, 4, 8}) == 4);
+  CHECK(uptune::mode() == uptune::Mode::Default);
+  CHECK(uptune::get_global_id() == -1);
+}
+
+void phase_analysis() {
+  CHECK(uptune::mode() == uptune::Mode::Analysis);
+  CHECK(uptune::tune(2, {1, 8}, "bs") == 2);
+  CHECK(uptune::tune(0.5, std::make_pair(0.0, 1.0), "alpha") == 0.5);
+  CHECK(uptune::tune(false, "flag") == false);
+  CHECK(uptune::tune("O1", {"O0", "O1", "O2"}, "opt") == "O1");
+  CHECK(uptune::tune(7, {0, 100}) == 7);  // unnamed -> auto v0_4
+  uptune::target(42.0, "min");
+}
+
+void phase_tune() {
+  CHECK(uptune::mode() == uptune::Mode::Tune);
+  CHECK(uptune::get_local_id() == 3);
+  CHECK(uptune::get_global_id() == 99);
+  CHECK(uptune::tune(2, {1, 8}, "bs") == 5);
+  CHECK(std::fabs(uptune::tune(0.5, std::make_pair(0.0, 1.0), "alpha") -
+                   0.25) < 1e-12);
+  CHECK(uptune::tune(false, "flag") == true);
+  CHECK(uptune::tune("O1", {"O0", "O1", "O2"}, "opt") == "O2");
+  // unnamed call binds positionally via ut.params.json (types.py:132-134)
+  CHECK(uptune::tune(7, {0, 100}) == 63);
+  uptune::target(3.5, "min");
+  uptune::target(4.5, "min");  // second report appends a second row
+}
+
+void phase_best() {
+  CHECK(uptune::mode() == uptune::Mode::Best);
+  CHECK(uptune::tune(2, {1, 8}, "bs") == 6);
+  // unnamed: positional binding must work in BEST mode too (the ADVICE
+  // round-1 finding on _load_best)
+  CHECK(uptune::tune(0.5, std::make_pair(0.0, 1.0), "alpha") == 0.5);
+  CHECK(uptune::tune(false, "flag") == false);
+  CHECK(uptune::tune("O1", {"O0", "O1", "O2"}, "opt") == "O1");
+  CHECK(uptune::tune(7, {0, 100}) == 31);
+}
+
+void phase_tune_missing() {
+  // no proposal published: every call falls back to its origin
+  CHECK(uptune::mode() == uptune::Mode::Tune);
+  CHECK(uptune::tune(2, {1, 8}, "bs") == 2);
+  CHECK(uptune::tune("O1", {"O0", "O1", "O2"}, "opt") == "O1");
+}
+
+// ------------------------------------------------------------ orchestrate
+
+int orchestrate(const std::string& self) {
+  char tmpl[] = "/tmp/utcpp.XXXXXX";
+  CHECK(mkdtemp(tmpl) != nullptr);
+  g_dir = tmpl;
+  CHECK(std::system(("mkdir -p " + g_dir + "/configs").c_str()) == 0);
+
+  CHECK(run_phase(self, "", "default") == 0);
+
+  // ANALYSIS writes the space + default QoR
+  CHECK(run_phase(self, "UT_BEFORE_RUN_PROFILE=On", "analysis") == 0);
+  auto params = uptune::json::parse(read_all(g_dir + "/ut.params.json"));
+  CHECK(params.size() == 1 && params.at(0).size() == 5);
+  const auto& bs = params.at(0).at(0);
+  CHECK(bs.at("name").as_string() == "bs");
+  CHECK(bs.at("type").as_string() == "int");
+  CHECK(bs.at("lo").as_int() == 1 && bs.at("hi").as_int() == 8);
+  CHECK(bs.at("default").as_int() == 2);
+  CHECK(params.at(0).at(1).at("type").as_string() == "float");
+  CHECK(params.at(0).at(2).at("type").as_string() == "bool");
+  CHECK(params.at(0).at(3).at("type").as_string() == "enum");
+  CHECK(params.at(0).at(3).at("options").size() == 3);
+  CHECK(params.at(0).at(4).at("name").as_string() == "v0_4");
+  auto dq = uptune::json::parse(read_all(g_dir + "/ut.default_qor.json"));
+  CHECK(dq.at("qor").as_double() == 42.0);
+  CHECK(dq.at("trend").as_string() == "min");
+
+  // TUNE serves the published proposal and writes QoR rows
+  write_all(g_dir + "/configs/ut.dr_stage0_index3.json",
+            "{\"bs\": 5, \"alpha\": 0.25, \"flag\": true, "
+            "\"opt\": \"O2\", \"v0_4\": 63}");
+  CHECK(run_phase(self,
+                   "UT_TUNE_START=True UT_CURR_INDEX=3 UT_GLOBAL_ID=99",
+                   "tune") == 0);
+  auto qor = uptune::json::parse(read_all(g_dir + "/ut.qor_stage0.json"));
+  CHECK(qor.size() == 2);
+  CHECK(qor.at(0).at(0).as_int() == 3);
+  CHECK(qor.at(0).at(1).as_double() == 3.5);
+  CHECK(qor.at(0).at(2).as_string() == "min");
+  CHECK(qor.at(1).at(1).as_double() == 4.5);
+
+  // BEST serves best.json ({"config": ..., "qor": ...} shape)
+  write_all(g_dir + "/best.json",
+            "{\"config\": {\"bs\": 6, \"v0_4\": 31}, \"qor\": 1.0}");
+  CHECK(run_phase(self, "BEST=True", "best") == 0);
+
+  // TUNE with no published config degrades to defaults
+  CHECK(std::system(("rm " + g_dir +
+                      "/configs/ut.dr_stage0_index3.json").c_str()) == 0);
+  CHECK(run_phase(self, "UT_TUNE_START=True UT_CURR_INDEX=3",
+                   "tune_missing") == 0);
+
+  CHECK(std::system(("rm -rf " + g_dir).c_str()) == 0);
+  std::printf("cpp client: all phases passed\n");
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) return orchestrate(argv[0]);
+  std::string phase = argv[1];
+  g_dir = std::getenv("UT_WORK_DIR") ? std::getenv("UT_WORK_DIR") : ".";
+  if (phase == "default") phase_default();
+  else if (phase == "analysis") phase_analysis();
+  else if (phase == "tune") phase_tune();
+  else if (phase == "best") phase_best();
+  else if (phase == "tune_missing") phase_tune_missing();
+  else return 2;
+  return 0;
+}
