@@ -1,0 +1,136 @@
+"""Surrogate ranking-quality parity benchmark.
+
+The reference prunes proposals with an XGBoost regressor ensemble
+(300 trees, depth 10, lr 0.015, 94-feature vectors —
+/root/reference/python/uptune/plugins/xgbregressor.py:35-44,55).  The
+multivoting filter only works if the surrogate RANKS candidates well, so
+the bar for the JAX GP/MLP replacement is ranking parity with a strong
+tree oracle on EDA-shaped data (SURVEY §7.5).
+
+xgboost is not in this environment; the oracle is sklearn's
+GradientBoostingRegressor with the reference's exact hyperparameters —
+the same algorithm family (gradient-boosted depth-10 trees).
+
+Usage:  python scripts/surrogate_bench.py [--n 600] [--feat 94]
+Prints one JSON line per model: spearman + precision@10% on a held-out
+split of a synthetic 94-feature EDA-like response surface.
+"""
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_eda_dataset(seed: int, n: int, n_feat: int = 94,
+                     noise: float = 0.05, fn_seed: int = 1234):
+    """Synthetic post-synthesis-QoR-like surface over [0,1]^F: sparse
+    linear trend + threshold (resource cliff) effects + pairwise
+    interactions + many irrelevant features + mild heteroscedastic
+    noise — the qualitative structure of EDA report features.
+
+    The response FUNCTION is drawn from `fn_seed` (fixed across
+    train/test splits); `seed` draws only the sample points."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, n_feat).astype(np.float32)
+    fn_rng = np.random.RandomState(fn_seed)
+    w = np.zeros(n_feat, np.float32)
+    active = fn_rng.choice(n_feat, 20, replace=False)
+    w[active] = fn_rng.randn(20).astype(np.float32)
+    y = x @ w
+    y += 2.0 * np.sin(3 * np.pi * x[:, 0]) * x[:, 1]
+    y += 3.0 * (x[:, 2] > 0.7) * x[:, 3]          # resource cliff
+    y += 2.0 * x[:, 4] * x[:, 5]
+    y += 1.5 * (x[:, 6] - 0.5) ** 2
+    y += noise * (1.0 + x[:, 7]) * rng.randn(n).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def spearman(a: np.ndarray, b: np.ndarray) -> float:
+    ra = np.argsort(np.argsort(a)).astype(np.float64)
+    rb = np.argsort(np.argsort(b)).astype(np.float64)
+    ra -= ra.mean()
+    rb -= rb.mean()
+    return float((ra * rb).sum() /
+                 np.sqrt((ra ** 2).sum() * (rb ** 2).sum()))
+
+
+def precision_at(a_true: np.ndarray, a_pred: np.ndarray,
+                 frac: float = 0.1) -> float:
+    """Fraction of the predicted-best `frac` that are truly best-`frac`
+    (minimization: smaller is better)."""
+    k = max(1, int(len(a_true) * frac))
+    top_true = set(np.argsort(a_true)[:k].tolist())
+    top_pred = set(np.argsort(a_pred)[:k].tolist())
+    return len(top_true & top_pred) / k
+
+
+def run(n: int = 600, n_feat: int = 94, n_test: int = 300,
+        seed: int = 0, quick: bool = False):
+    xtr, ytr = make_eda_dataset(seed, n, n_feat)
+    xte, yte = make_eda_dataset(seed + 1, n_test, n_feat)
+    out = {}
+
+    # tree oracle (reference hyperparameters, xgbregressor.py:35-44)
+    from sklearn.ensemble import GradientBoostingRegressor
+    t0 = time.time()
+    gbr = GradientBoostingRegressor(
+        n_estimators=50 if quick else 300, max_depth=10,
+        learning_rate=0.1 if quick else 0.015, random_state=seed)
+    gbr.fit(xtr, ytr)
+    pred = gbr.predict(xte)
+    out["oracle_gbt"] = {
+        "spearman": spearman(yte, pred),
+        "p_at_10": precision_at(yte, pred),
+        "fit_s": round(time.time() - t0, 2),
+    }
+
+    import jax
+    import jax.numpy as jnp
+    from uptune_tpu.surrogate import gp, mlp
+
+    t0 = time.time()
+    state = jax.jit(gp.fit_auto)(jnp.asarray(xtr), jnp.asarray(ytr))
+    mu, _ = jax.jit(gp.predict)(state, jnp.asarray(xte))
+    out["gp_mll"] = {
+        "spearman": spearman(yte, np.asarray(mu)),
+        "p_at_10": precision_at(yte, np.asarray(mu)),
+        "fit_s": round(time.time() - t0, 2),
+        "lengthscale": round(float(state.lengthscale), 4),
+        "noise": float(state.noise),
+    }
+
+    t0 = time.time()
+    state_f = jax.jit(lambda x, y: gp.fit(x, y))(
+        jnp.asarray(xtr), jnp.asarray(ytr))
+    mu_f, _ = jax.jit(gp.predict)(state_f, jnp.asarray(xte))
+    out["gp_fixed"] = {
+        "spearman": spearman(yte, np.asarray(mu_f)),
+        "p_at_10": precision_at(yte, np.asarray(mu_f)),
+        "fit_s": round(time.time() - t0, 2),
+    }
+
+    t0 = time.time()
+    ms = jax.jit(lambda k, x, y: mlp.fit(k, x, y))(
+        jax.random.PRNGKey(seed), jnp.asarray(xtr), jnp.asarray(ytr))
+    mmu, _ = jax.jit(mlp.predict)(ms, jnp.asarray(xte))
+    out["mlp_ens"] = {
+        "spearman": spearman(yte, np.asarray(mmu)),
+        "p_at_10": precision_at(yte, np.asarray(mmu)),
+        "fit_s": round(time.time() - t0, 2),
+    }
+    return out
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, "scripts")
+    import cpuenv  # noqa: F401  (hang-proof platform for standalone runs)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=600)
+    ap.add_argument("--feat", type=int, default=94)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    for name, metrics in run(n=args.n, n_feat=args.feat,
+                             quick=args.quick).items():
+        print(json.dumps({"model": name, **metrics}))

@@ -1,0 +1,131 @@
+"""Surrogate ranking-quality parity vs a gradient-boosted-tree oracle
+(SURVEY §7.5 bar: the JAX surrogate must match the reference's
+XGBoost-300-tree ranking quality on 94-feature EDA-style data,
+/root/reference/python/uptune/plugins/xgbregressor.py:35-44,55 — here the
+oracle is sklearn GBT with the reference's hyperparameters, since
+xgboost is not in the image), plus MLL hyperparameter selection and
+masked-padding invariance checks."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from uptune_tpu.surrogate import gp, mlp  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "scripts"))
+from surrogate_bench import (make_eda_dataset, precision_at, run,  # noqa: E402
+                             spearman)
+
+
+@pytest.fixture(scope="module")
+def results():
+    return run(n=400, n_test=200, quick=True)
+
+
+class TestParity:
+    def test_gp_mll_beats_tree_oracle(self, results):
+        """The headline: marginal-likelihood-fitted GP must be within
+        0.05 Spearman of the tree oracle (measured: GP 0.89 vs GBT
+        0.64 — it wins outright)."""
+        assert results["gp_mll"]["spearman"] >= \
+            results["oracle_gbt"]["spearman"] - 0.05
+        assert results["gp_mll"]["p_at_10"] >= \
+            results["oracle_gbt"]["p_at_10"] - 0.1
+
+    def test_gp_mll_absolute_quality(self, results):
+        assert results["gp_mll"]["spearman"] > 0.7
+        assert results["gp_mll"]["p_at_10"] > 0.4
+
+    def test_mll_fitting_improves_on_fixed(self, results):
+        """Round-1's fixed (0.3, 1e-3) was the VERDICT's weak #5; the
+        fitted GP must clearly beat it on the EDA surface."""
+        assert results["gp_mll"]["spearman"] > \
+            results["gp_fixed"]["spearman"] + 0.1
+
+    def test_mlp_ensemble_competitive(self, results):
+        assert results["mlp_ens"]["spearman"] >= \
+            results["oracle_gbt"]["spearman"] - 0.1
+
+
+class TestMLL:
+    def test_mll_selects_sensible_lengthscale(self):
+        """On a smooth 1-feature surface sampled densely, the evidence
+        must prefer a long lengthscale over a tiny one."""
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(80, 1), jnp.float32)
+        y = jnp.sin(2 * x[:, 0]) + 0.01 * jnp.asarray(rng.randn(80))
+        mll_long = gp.log_marginal_likelihood(x, y, 1.0, 1e-3)
+        mll_short = gp.log_marginal_likelihood(x, y, 0.01, 1e-3)
+        assert float(mll_long) > float(mll_short)
+
+    def test_mll_mask_invariance(self):
+        """Padded rows must contribute exactly zero evidence."""
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.rand(32, 3), jnp.float32)
+        y = jnp.asarray(rng.randn(32), jnp.float32)
+        base = gp.log_marginal_likelihood(x, y, 0.5, 1e-2)
+        xp = jnp.concatenate([x, jnp.zeros((16, 3))])
+        yp = jnp.concatenate([y, jnp.zeros(16)])
+        mask = jnp.concatenate([jnp.ones(32), jnp.zeros(16)])
+        padded = gp.log_marginal_likelihood(xp, yp, 0.5, 1e-2, mask)
+        assert float(base) == pytest.approx(float(padded), rel=1e-4)
+
+
+class TestMaskedFit:
+    def test_gp_padding_exact(self):
+        """fit() on padded+masked data must produce the same predictions
+        (mean AND variance) as the unpadded fit."""
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.rand(40, 4), jnp.float32)
+        y = jnp.asarray(rng.randn(40), jnp.float32)
+        xq = jnp.asarray(rng.rand(16, 4), jnp.float32)
+        s0 = gp.fit(x, y, 0.4, 1e-2)
+        mu0, sd0 = gp.predict(s0, xq)
+        xp = jnp.concatenate([x, jnp.zeros((24, 4))])
+        yp = jnp.concatenate([y, jnp.zeros(24)])
+        mask = jnp.concatenate([jnp.ones(40), jnp.zeros(24)])
+        s1 = gp.fit(xp, yp, 0.4, 1e-2, mask)
+        mu1, sd1 = gp.predict(s1, xq)
+        np.testing.assert_allclose(np.asarray(mu0), np.asarray(mu1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(sd0), np.asarray(sd1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mlp_padding_close(self):
+        """Masked MLP training must match unpadded training (identical
+        normalization + loss; same RNG -> same parameters)."""
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.rand(50, 4), jnp.float32)
+        y = jnp.asarray(rng.randn(50), jnp.float32)
+        xq = jnp.asarray(rng.rand(8, 4), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        m0, _ = mlp.predict(mlp.fit(key, x, y, steps=50), xq)
+        xp = jnp.concatenate([x, jnp.zeros((14, 4))])
+        yp = jnp.concatenate([y, jnp.zeros(14)])
+        mask = jnp.concatenate([jnp.ones(50), jnp.zeros(14)])
+        m1, _ = mlp.predict(mlp.fit(key, xp, yp, steps=50, mask=mask), xq)
+        np.testing.assert_allclose(np.asarray(m0), np.asarray(m1),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestDatasetSanity:
+    def test_train_test_share_function(self):
+        """Regression guard for the benchmark itself: different sample
+        seeds must share the response function."""
+        x1, y1 = make_eda_dataset(0, 50)
+        x2, y2 = make_eda_dataset(1, 50)
+        assert not np.allclose(x1, x2)
+        # same x -> same y (up to noise): re-draw with same seed
+        x3, y3 = make_eda_dataset(0, 50)
+        np.testing.assert_allclose(y1, y3)
+
+    def test_metrics(self):
+        a = np.arange(10.0)
+        assert spearman(a, a) == pytest.approx(1.0)
+        assert spearman(a, -a) == pytest.approx(-1.0)
+        assert precision_at(a, a, 0.2) == 1.0

@@ -13,20 +13,21 @@ by QoR plus a random draw of the rest) so the O(N^3) fit stays bounded.
 from __future__ import annotations
 
 import math
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
 class GPState(NamedTuple):
-    x: jax.Array        # [N, F] training features
+    x: jax.Array        # [N, F] training features (maybe padded rows)
     alpha: jax.Array    # [N] K^-1 (y - mean)
     chol: jax.Array     # [N, N] lower Cholesky of K + noise I
     y_mean: jax.Array   # scalar
     y_std: jax.Array    # scalar
     lengthscale: jax.Array
     noise: jax.Array
+    mask: jax.Array     # [N] 1.0 = real training row, 0.0 = padding
 
 
 def _matern52(x1: jax.Array, x2: jax.Array, ls: jax.Array) -> jax.Array:
@@ -38,28 +39,113 @@ def _matern52(x1: jax.Array, x2: jax.Array, ls: jax.Array) -> jax.Array:
     return (1.0 + s5d + (5.0 / 3.0) * d2) * jnp.exp(-s5d)
 
 
-def fit(x: jax.Array, y: jax.Array, lengthscale: float = 0.3,
-        noise: float = 1e-3) -> GPState:
-    """Fit on standardized targets; non-finite targets are clamped to the
-    worst finite value (failed builds carry signal, reference feeds them
-    as inf to the archive)."""
+def _standardize(y: jax.Array, mask: Optional[jax.Array]
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Clamp non-finite targets to the worst finite value (failed builds
+    carry signal, reference feeds them as inf to the archive), then
+    standardize over the real (masked-in) rows."""
     finite = jnp.isfinite(y)
     worst = jnp.max(jnp.where(finite, y, -jnp.inf))
     y = jnp.where(finite, y, worst)
-    mean = y.mean()
-    std = jnp.maximum(y.std(), 1e-8)
+    if mask is None:
+        mean = y.mean()
+        std = jnp.maximum(y.std(), 1e-8)
+    else:
+        n = jnp.maximum(mask.sum(), 1.0)
+        mean = (y * mask).sum() / n
+        std = jnp.maximum(
+            jnp.sqrt((mask * (y - mean) ** 2).sum() / n), 1e-8)
     yn = (y - mean) / std
+    if mask is not None:
+        yn = yn * mask
+    return yn, mean, std
+
+
+def _masked_kernel(x: jax.Array, ls: jax.Array, noise: jax.Array,
+                   mask: Optional[jax.Array]) -> jax.Array:
+    """K + noise*I with padded rows replaced by independent unit-variance
+    points: zero off-diagonal coupling, 1 on the diagonal.  The Cholesky
+    of such a matrix leaves the real-row entries identical to the
+    unpadded factorization, so padding changes nothing numerically —
+    it only makes the shape static for jit-cache reuse."""
+    k = _matern52(x, x, ls)
+    if mask is not None:
+        mm = mask[:, None] * mask[None, :]
+        k = mm * k + jnp.diag(1.0 - mask)
+    return k + noise * jnp.eye(x.shape[0])
+
+
+def fit(x: jax.Array, y: jax.Array, lengthscale: float = 0.3,
+        noise: float = 1e-3,
+        mask: Optional[jax.Array] = None) -> GPState:
+    """Exact GP fit at fixed hyperparameters.  `mask` ([N] 1.0=real,
+    0.0=padding) lets callers pad the training set to a bucketed static
+    shape without recompiles or result changes."""
+    yn, mean, std = _standardize(y, mask)
     ls = jnp.asarray(lengthscale, jnp.float32)
-    k = _matern52(x, x, ls) + noise * jnp.eye(x.shape[0])
+    nz = jnp.asarray(noise, jnp.float32)
+    k = _masked_kernel(x, ls, nz, mask)
     chol = jnp.linalg.cholesky(k)
     alpha = jax.scipy.linalg.cho_solve((chol, True), yn)
-    return GPState(x, alpha, chol, mean, std,
-                   ls, jnp.asarray(noise, jnp.float32))
+    m = jnp.ones(x.shape[0]) if mask is None else mask
+    return GPState(x, alpha, chol, mean, std, ls, nz, m)
+
+
+# hyperparameter grid for fit_auto: log-spaced lengthscales (unit-cube
+# features, so 0.03..5 covers very wiggly..nearly-linear) x noise floors
+DEFAULT_LS_GRID = (0.05, 0.1, 0.2, 0.3, 0.5, 0.8, 1.3, 2.0, 3.0)
+DEFAULT_NOISE_GRID = (1e-4, 1e-3, 1e-2, 1e-1)
+
+
+def log_marginal_likelihood(x: jax.Array, y: jax.Array,
+                            lengthscale: jax.Array, noise: jax.Array,
+                            mask: Optional[jax.Array] = None) -> jax.Array:
+    """Exact GP log evidence on standardized targets; padded rows
+    contribute exactly zero (their quadratic term is 0 and their
+    log-diagonal entries are masked out)."""
+    yn, _, _ = _standardize(y, mask)
+    k = _masked_kernel(x, jnp.asarray(lengthscale, jnp.float32),
+                       jnp.asarray(noise, jnp.float32), mask)
+    chol = jnp.linalg.cholesky(k)
+    alpha = jax.scipy.linalg.cho_solve((chol, True), yn)
+    logdiag = jnp.log(jnp.diagonal(chol))
+    if mask is not None:
+        logdiag = logdiag * mask
+        n = mask.sum()
+    else:
+        n = float(x.shape[0])
+    return (-0.5 * (yn * alpha).sum() - logdiag.sum()
+            - 0.5 * n * math.log(2 * math.pi))
+
+
+def fit_auto(x: jax.Array, y: jax.Array,
+             mask: Optional[jax.Array] = None,
+             ls_grid: Sequence[float] = DEFAULT_LS_GRID,
+             noise_grid: Sequence[float] = DEFAULT_NOISE_GRID) -> GPState:
+    """Fit with (lengthscale, noise) chosen by marginal likelihood over a
+    grid — the round-1 fixed (0.3, 1e-3) had no evidence behind it
+    (VERDICT weak #5).  The grid sweep is one lax.map of Cholesky solves
+    (static shapes, MXU-friendly); the winner is refit once.
+
+    The reference's XGBoost surrogate tunes nothing online either
+    (plugins/xgbregressor.py:35-44 hardcodes 300 trees / depth 10); this
+    is where the GP must earn its ranking-quality parity."""
+    grid = jnp.asarray([(ls, nz) for ls in ls_grid for nz in noise_grid],
+                       jnp.float32)
+
+    def mll(hp):
+        return log_marginal_likelihood(x, y, hp[0], hp[1], mask)
+
+    scores = jax.lax.map(mll, grid)
+    best = jnp.argmax(scores)
+    ls, nz = grid[best, 0], grid[best, 1]
+    return fit(x, y, ls, nz, mask)
 
 
 def predict(state: GPState, xq: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """[B, F] -> (mean [B], std [B]) in original target units."""
     kq = _matern52(xq, state.x, state.lengthscale)       # [B, N]
+    kq = kq * state.mask[None, :]   # padded rows must not shrink variance
     mu = kq @ state.alpha
     v = jax.scipy.linalg.solve_triangular(state.chol, kq.T, lower=True)
     var = jnp.maximum(1.0 + state.noise - (v ** 2).sum(0), 1e-9)

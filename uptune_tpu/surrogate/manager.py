@@ -32,7 +32,8 @@ class SurrogateManager:
                  min_points: int = 64, refit_interval: int = 64,
                  keep_quantile: float = 0.5, majority: float = 0.5,
                  explore_frac: float = 0.1, max_points: int = 1024,
-                 n_members: int = 4, seed: int = 0):
+                 n_members: int = 4, seed: int = 0,
+                 hyper_fit: bool = True):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         self.space = space
@@ -52,11 +53,13 @@ class SurrogateManager:
         self._threshold = None
 
         if kind == "gp":
-            self._fit = jax.jit(gp_mod.fit)
+            self._fit = jax.jit(
+                gp_mod.fit_auto if hyper_fit
+                else lambda x, y, mask: gp_mod.fit(x, y, mask=mask))
             self._score = jax.jit(gp_mod.lower_confidence_bound)
         else:
-            self._fit = jax.jit(
-                lambda k, x, y: mlp_mod.fit(k, x, y, n_members=n_members))
+            self._fit = jax.jit(lambda k, x, y, mask: mlp_mod.fit(
+                k, x, y, n_members=n_members, mask=mask))
             self._score = jax.jit(mlp_mod.predict_members)
 
     # ------------------------------------------------------------------
@@ -84,10 +87,23 @@ class SurrogateManager:
         y = jnp.asarray(np.asarray(self._ys, np.float32))
         self._key, ks, kf = jax.random.split(self._key, 3)
         x, y = gp_mod.subsample(ks, x, y, self.max_points)
+        # pad to the next power-of-two bucket so the jitted fit compiles
+        # once per bucket instead of once per growing N (ADVICE round 1:
+        # every refit below max_points re-traced the O(N^3) program)
+        n = x.shape[0]
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        bucket = min(bucket, max(self.max_points, n))
+        mask = jnp.concatenate(
+            [jnp.ones(n), jnp.zeros(bucket - n)]).astype(x.dtype)
+        x = jnp.concatenate([x, jnp.zeros((bucket - n, x.shape[1]),
+                                          x.dtype)])
+        y = jnp.concatenate([y, jnp.zeros(bucket - n, y.dtype)])
         if self.kind == "gp":
-            self._state = self._fit(x, y)
+            self._state = self._fit(x, y, mask)
         else:
-            self._state = self._fit(kf, x, y)
+            self._state = self._fit(kf, x, y, mask)
         finite = [v for v in self._ys if np.isfinite(v)]
         self._threshold = float(
             np.quantile(finite, self.keep_quantile)) if finite else None

@@ -39,14 +39,26 @@ def _forward(params, x):
 
 
 def fit(key: jax.Array, x: jax.Array, y: jax.Array, n_members: int = 4,
-        width: int = 64, steps: int = 300,
-        lr: float = 3e-3) -> MLPEnsembleState:
-    """Train the whole ensemble with vmapped full-batch Adam."""
+        width: int = 64, steps: int = 300, lr: float = 3e-3,
+        mask: jax.Array = None) -> MLPEnsembleState:
+    """Train the whole ensemble with vmapped full-batch Adam.  `mask`
+    ([N] 1.0=real, 0.0=padding) weights the loss and the normalization
+    stats so callers can pad to bucketed static shapes (jit-cache
+    reuse) without biasing the fit."""
     finite = jnp.isfinite(y)
     worst = jnp.max(jnp.where(finite, y, -jnp.inf))
     y = jnp.where(finite, y, worst)
-    x_mean, x_std = x.mean(0), jnp.maximum(x.std(0), 1e-8)
-    y_mean, y_std = y.mean(), jnp.maximum(y.std(), 1e-8)
+    if mask is None:
+        w = jnp.ones(x.shape[0])
+    else:
+        w = mask
+    n = jnp.maximum(w.sum(), 1.0)
+    x_mean = (x * w[:, None]).sum(0) / n
+    x_std = jnp.maximum(
+        jnp.sqrt((w[:, None] * (x - x_mean) ** 2).sum(0) / n), 1e-8)
+    y_mean = (y * w).sum() / n
+    y_std = jnp.maximum(
+        jnp.sqrt((w * (y - y_mean) ** 2).sum() / n), 1e-8)
     xn = (x - x_mean) / x_std
     yn = (y - y_mean) / y_std
     sizes = (x.shape[1], width, width, 1)
@@ -59,7 +71,7 @@ def fit(key: jax.Array, x: jax.Array, y: jax.Array, n_members: int = 4,
 
         def loss_fn(p):
             pred = _forward(p, xn)
-            return jnp.mean((pred - yn) ** 2)
+            return (w * (pred - yn) ** 2).sum() / n
 
         def body(carry, i):
             params, m, v = carry
