@@ -1,0 +1,26 @@
+"""Synthetic polynomial with covariates for causal discovery — the
+shape of the reference sample (/root/reference/samples/causal-graph/
+poly.py:1-17): two intermediate quantities are registered as
+`ut.feature` covariates; after tuning, NOTEARS over the archived
+covariates + QoR identifies which one drives the objective.
+
+Tune:     ut samples/causal-graph/poly.py -pf 2 --test-limit 60
+Analyze:  python -c "from uptune_tpu.plugins import covariate_graph; ..."
+          (see tests/test_notears.py::TestCovariateGraph)
+"""
+import uptune_tpu as ut
+
+x = ut.tune(2, (2, 15), name="x")
+y = ut.tune(5, (2, 12), name="y")
+a = ut.tune(2, (2, 15), name="a")
+b = ut.tune(5, (2, 12), name="b")
+
+# expected causal graph: ab -> res <- xy
+xy = x * y + x * x
+ab = a * a + b * b + a * b
+
+res = ab - xy
+ut.feature(ab, "ab")
+ut.feature(xy, "xy")
+
+ut.target(res, "max")

@@ -24,7 +24,10 @@ from surrogate_bench import (make_eda_dataset, precision_at, run,  # noqa: E402
 
 @pytest.fixture(scope="module")
 def results():
-    return run(n=400, n_test=200, quick=True)
+    # quick=False: the oracle must be the REFERENCE configuration
+    # (300 trees / depth 10 / lr 0.015) — a weaker quick-mode oracle
+    # would let a GP regression below the real bar pass
+    return run(n=400, n_test=200, quick=False)
 
 
 class TestParity:

@@ -1,0 +1,6 @@
+"""ML plugins (the reference's L0 layer, SURVEY §1): NOTEARS causal
+discovery as JAX kernels; the surrogate-model plugins live in
+`uptune_tpu.surrogate`, the QuickEst estimator in `uptune_tpu.quickest`."""
+from .notears import covariate_graph, h_func, notears, simulate_dag
+
+__all__ = ["notears", "h_func", "covariate_graph", "simulate_dag"]

@@ -45,6 +45,8 @@ def _standardize(y: jax.Array, mask: Optional[jax.Array]
     carry signal, reference feeds them as inf to the archive), then
     standardize over the real (masked-in) rows."""
     finite = jnp.isfinite(y)
+    if mask is not None:
+        finite = finite & (mask > 0)   # padding rows are not data
     worst = jnp.max(jnp.where(finite, y, -jnp.inf))
     y = jnp.where(finite, y, worst)
     if mask is None:
@@ -137,6 +139,9 @@ def fit_auto(x: jax.Array, y: jax.Array,
         return log_marginal_likelihood(x, y, hp[0], hp[1], mask)
 
     scores = jax.lax.map(mll, grid)
+    # a near-singular K (f32 Cholesky on clustered configs) yields NaN
+    # evidence; NaN wins argmax and poisons the refit — mask it out
+    scores = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
     best = jnp.argmax(scores)
     ls, nz = grid[best, 0], grid[best, 1]
     return fit(x, y, ls, nz, mask)

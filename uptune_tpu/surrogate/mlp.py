@@ -45,13 +45,13 @@ def fit(key: jax.Array, x: jax.Array, y: jax.Array, n_members: int = 4,
     ([N] 1.0=real, 0.0=padding) weights the loss and the normalization
     stats so callers can pad to bucketed static shapes (jit-cache
     reuse) without biasing the fit."""
-    finite = jnp.isfinite(y)
-    worst = jnp.max(jnp.where(finite, y, -jnp.inf))
-    y = jnp.where(finite, y, worst)
     if mask is None:
         w = jnp.ones(x.shape[0])
     else:
         w = mask
+    finite = jnp.isfinite(y) & (w > 0)   # padding rows are not data
+    worst = jnp.max(jnp.where(finite, y, -jnp.inf))
+    y = jnp.where(finite, y, worst)
     n = jnp.maximum(w.sum(), 1.0)
     x_mean = (x * w[:, None]).sum(0) / n
     x_std = jnp.maximum(
