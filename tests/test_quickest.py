@@ -1,0 +1,123 @@
+"""QuickEst estimator tests (the reference pipeline, /root/reference/
+python/uptune/quickest/, had no automated tests — train/test were CLI
+entry points over private CSV data)."""
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from uptune_tpu.quickest import QuickEst, load_csv, preprocess  # noqa: E402
+from uptune_tpu.quickest import predict as q_predict  # noqa: E402
+from uptune_tpu.quickest import test as q_test  # noqa: E402
+from uptune_tpu.quickest import train as q_train  # noqa: E402
+from uptune_tpu.quickest.pipeline import (_lasso_fit, apply_preprocess,  # noqa: E402
+                                          r2_score, rae)
+
+
+def _dataset(seed=0, n=400, f=40):
+    """Sparse nonlinear multi-target surface: only 8 features matter."""
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, f).astype(np.float32)
+    lut = (3.0 * x[:, 0] + 2.0 * x[:, 1] * x[:, 2] - x[:, 3]
+           + 0.5 * np.sin(3 * x[:, 4]) + 0.05 * rng.randn(n))
+    ff = (1.5 * x[:, 5] + x[:, 6] ** 2 + 0.4 * x[:, 7]
+          + 0.05 * rng.randn(n))
+    return x, np.stack([lut, ff], 1).astype(np.float32)
+
+
+class TestPreprocess:
+    def test_impute_and_drop(self):
+        x = np.asarray([[1.0, np.nan, 5.0],
+                        [2.0, np.nan, 5.0],
+                        [3.0, np.nan, 5.0]], np.float32)
+        out, meta = preprocess(x)
+        # col1 imputed to its (empty->0) median then dropped as constant,
+        # col2 constant -> dropped
+        assert out.shape == (3, 1)
+        assert meta["kept"] == [0]
+        x2 = apply_preprocess(
+            np.asarray([[9.0, 1.0, 2.0]], np.float32), meta)
+        assert x2.shape == (1, 1) and x2[0, 0] == 9.0
+
+
+class TestLasso:
+    def test_sparse_recovery(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        x = rng.randn(300, 20).astype(np.float32)
+        y = 2.0 * x[:, 3] - 1.0 * x[:, 7] + 0.02 * rng.randn(300)
+        w, b = _lasso_fit(jnp.asarray(x), jnp.asarray(y), lam=0.05)
+        w = np.asarray(w)
+        top = set(np.argsort(-np.abs(w))[:2].tolist())
+        assert top == {3, 7}
+        # most other coefficients shrunk to (near) zero
+        rest = np.delete(np.abs(w), [3, 7])
+        assert (rest < 0.05).mean() > 0.9
+
+
+class TestQuickEst:
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        x, y = _dataset()
+        return QuickEst().fit(x, y, ["LUT_impl", "FF_impl"]), _dataset(1)
+
+    def test_accuracy(self, fitted):
+        est, (xt, yt) = fitted
+        scores = est.score(xt, yt, ["LUT_impl", "FF_impl"])
+        assert scores["LUT_impl"]["r2"] > 0.85, scores
+        assert scores["FF_impl"]["r2"] > 0.85, scores
+        assert scores["LUT_impl"]["rae"] < 0.35, scores
+
+    def test_feature_selection_found_signal(self, fitted):
+        est, _ = fitted
+        sel = set(est.models["LUT_impl"].sel.tolist())
+        assert {0, 1, 3}.issubset(sel)   # strongest LUT drivers
+
+    def test_predict_single_row(self, fitted):
+        est, (xt, yt) = fitted
+        p = est.predict(xt[0], "LUT_impl")
+        assert p.shape == (1,)
+        assert abs(float(p[0]) - yt[0, 0]) < 1.5
+
+    def test_unknown_target(self, fitted):
+        est, _ = fitted
+        with pytest.raises(KeyError):
+            est.predict(np.zeros(40), "BRAM_impl")
+
+    def test_save_load_round_trip(self, fitted, tmp_path):
+        est, (xt, _) = fitted
+        d = str(tmp_path / "models")
+        est.save(d)
+        est2 = QuickEst.load(d)
+        np.testing.assert_allclose(
+            est.predict(xt[:16], "FF_impl"),
+            est2.predict(xt[:16], "FF_impl"), rtol=1e-5)
+
+
+class TestModuleFacade:
+    def test_train_test_predict(self, tmp_path):
+        x, y = _dataset()
+        xt, yt = _dataset(2)
+        d = str(tmp_path / "db")
+        q_train(x, y[:, 0], ["LUT_impl"], save_dir=d, mlp_steps=200)
+        scores = q_test(xt, yt[:, 0], ["LUT_impl"], model_dir=d)
+        assert scores["LUT_impl"]["r2"] > 0.8
+        p = q_predict(xt[:4], "LUT_impl", model_dir=d)
+        assert p.shape == (4,)
+
+
+class TestCSV:
+    def test_load_csv(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("f0,f1,LUT_impl\n1,2,10\n3,x,30\n")
+        x, y, fn, tn = load_csv(str(p), ["LUT_impl"])
+        assert fn == ["f0", "f1"] and tn == ["LUT_impl"]
+        assert x.shape == (2, 2) and np.isnan(x[1, 1])
+        np.testing.assert_array_equal(y[:, 0], [10.0, 30.0])
+
+    def test_metrics(self):
+        y = np.asarray([1.0, 2.0, 3.0])
+        assert r2_score(y, y) == pytest.approx(1.0)
+        assert rae(y, y) == pytest.approx(0.0)

@@ -36,6 +36,12 @@ _LAZY = {
     "vars": ("uptune_tpu.api.constraint", "vars"),
     "model": ("uptune_tpu.api.tuner", "model"),
     "settings": ("uptune_tpu.api.session", "settings"),
+    # QuickEst estimator pipeline (reference __init__.py:10-43 maps
+    # preprocess/train/test from uptune.quickest)
+    "preprocess": ("uptune_tpu.quickest", "preprocess"),
+    "train": ("uptune_tpu.quickest", "train"),
+    "test": ("uptune_tpu.quickest", "test"),
+    "predict": ("uptune_tpu.quickest", "predict"),
 }
 
 

@@ -1,0 +1,19 @@
+"""QuickEst: fast QoR estimation from early-stage features.
+
+TPU-native port of the reference's offline estimator pipeline
+(`/root/reference/python/uptune/quickest/`: `train.py:500` train,
+`test.py:188` test / `test.py:227` predict(feats, target='LUT_impl'),
+`preprocess.py:56`), which predicts post-implementation FPGA
+resource/timing (LUT/FF/DSP/BRAM, slack) from early HLS report features
+using lasso + XGBoost per target with a stacked linear head.
+
+Here the per-target model is: JAX L1 linear model (ISTA) for feature
+selection -> MLP ensemble (uptune_tpu.surrogate.mlp) on the selected
+features -> a stacked combination of the linear and MLP heads fit on a
+validation split — all jitted, persisted as npz+json.
+"""
+from .pipeline import (QuickEst, load_csv, predict, preprocess, test,
+                       train)
+
+__all__ = ["QuickEst", "preprocess", "train", "test", "predict",
+           "load_csv"]
