@@ -108,6 +108,24 @@ class TestModuleFacade:
         assert p.shape == (4,)
 
 
+class TestRegressions:
+    def test_tiny_dataset_clear_error(self):
+        x, y = _dataset(n=8)
+        with pytest.raises(ValueError, match="16 training rows"):
+            QuickEst().fit(x, y[:, 0], ["T"])
+
+    def test_seed_option_accepted(self):
+        x, y = _dataset(n=60)
+        est = QuickEst(seed=3, mlp_steps=50).fit(x, y, ["A", "B"])
+        assert est.models["A"].seed == 3 and est.models["B"].seed == 4
+
+    def test_blank_csv_lines(self, tmp_path):
+        p = tmp_path / "b.csv"
+        p.write_text("f0,LUT_impl\n1,10\n\n2,20\n")
+        x, y, _, _ = load_csv(str(p), ["LUT_impl"])
+        assert x.shape == (2, 1)
+
+
 class TestCSV:
     def test_load_csv(self, tmp_path):
         p = tmp_path / "d.csv"

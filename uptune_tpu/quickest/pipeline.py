@@ -30,7 +30,7 @@ def load_csv(path: str, target_cols: Sequence[str]
     become NaN -> imputed by preprocess).  Returns
     (X, Y, feature_names, target_names)."""
     with open(path, newline="") as f:
-        rows = list(csv.reader(f))
+        rows = [r for r in csv.reader(f) if r]   # skip blank lines
     if not rows or len(rows) < 2:
         raise ValueError(f"{path}: need a header row + data rows")
     header = [h.strip() for h in rows[0]]
@@ -143,6 +143,9 @@ class _TargetModel:
         from ..surrogate import mlp as mlp_mod
 
         n = x.shape[0]
+        if n < 16:
+            raise ValueError(
+                f"QuickEst needs >= 16 training rows, got {n}")
         self.x_mean = x.mean(0)
         self.x_std = np.maximum(x.std(0), 1e-8)
         self.y_mean = float(y.mean())
@@ -150,7 +153,15 @@ class _TargetModel:
         xs = (x - self.x_mean) / self.x_std
         ys = (y - self.y_mean) / self.y_std
 
-        w, b = _lasso_fit(jnp.asarray(xs), jnp.asarray(ys), self.lam)
+        # both members train on `tr` only, so the `va` tail is genuinely
+        # held out for the stacking weights (the reference assembles on
+        # held-out data too, train.py:321-500)
+        n_val = max(4, n // 5)
+        tr = slice(0, n - n_val)
+        va = slice(n - n_val, n)
+
+        w, b = _lasso_fit(jnp.asarray(xs[tr]), jnp.asarray(ys[tr]),
+                          self.lam)
         self.w, self.b = np.asarray(w), float(b)
         order = np.argsort(-np.abs(self.w))
         k = min(self.top_k, xs.shape[1])
@@ -160,11 +171,6 @@ class _TargetModel:
             sel = order[:1]
         self.sel = np.sort(sel)
 
-        # train the MLP on the selected features; hold out a tail split
-        # for the stacking weights (assemble_models semantics)
-        n_val = max(8, n // 5)
-        tr = slice(0, n - n_val)
-        va = slice(n - n_val, n)
         self.mlp_state = mlp_mod.fit(
             jax.random.PRNGKey(self.seed), jnp.asarray(xs[tr][:, self.sel]),
             jnp.asarray(ys[tr]), n_members=self.n_members,
@@ -245,9 +251,11 @@ class QuickEst:
         x, self.pre_meta = preprocess(x)
         self.feature_names = (list(feature_names)
                               if feature_names is not None else None)
+        opts = dict(self.model_opts)
+        base_seed = opts.pop("seed", 0)
         for j, name in enumerate(target_names):
             self.models[name] = _TargetModel(
-                seed=j, **self.model_opts).fit(x, y[:, j])
+                seed=base_seed + j, **opts).fit(x, y[:, j])
         return self
 
     def predict(self, feats: np.ndarray,
