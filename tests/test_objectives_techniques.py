@@ -1,0 +1,159 @@
+"""Composite objectives + the round-2 technique-registry additions
+(BanditMutation, ComposableDE, generate_bandit_technique)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from uptune_tpu.driver.objectives import (MaximizeAccuracy,  # noqa: E402
+                                          MaximizeAccuracyMinimizeSize,
+                                          MinimizeTime,
+                                          ThresholdAccuracyMinimizeTime,
+                                          get_objective)
+from uptune_tpu.space.params import (FloatParam, IntParam,  # noqa: E402
+                                     PermParam)
+from uptune_tpu.space.spec import Space  # noqa: E402
+from uptune_tpu.techniques.banditmutation import (  # noqa: E402
+    BanditMutation, ComposableDE, generate_bandit_technique)
+from uptune_tpu.techniques.base import (all_technique_names,  # noqa: E402
+                                        get_technique)
+
+
+def _space(with_perm=False):
+    specs = [FloatParam(f"x{i}", -2.0, 2.0) for i in range(4)]
+    specs.append(IntParam("n", 0, 20))
+    if with_perm:
+        specs.append(PermParam("p", tuple(range(8))))
+    return Space(specs)
+
+
+class TestObjectives:
+    def test_minimize_time_order(self):
+        o = MinimizeTime()
+        assert o({"time": 1.0}) < o({"time": 2.0})
+
+    def test_maximize_accuracy_order(self):
+        o = MaximizeAccuracy()
+        assert o({"accuracy": 0.9}) < o({"accuracy": 0.5})
+
+    def test_acc_dominates_size(self):
+        o = MaximizeAccuracyMinimizeSize()
+        hi_acc_big = o({"accuracy": 0.9, "size": 5000.0})
+        lo_acc_small = o({"accuracy": 0.8, "size": 1.0})
+        assert hi_acc_big < lo_acc_small
+
+    def test_size_breaks_accuracy_ties(self):
+        o = MaximizeAccuracyMinimizeSize()
+        assert o({"accuracy": 0.9, "size": 10.0}) < \
+            o({"accuracy": 0.9, "size": 20.0})
+
+    def test_threshold_partitions(self):
+        o = ThresholdAccuracyMinimizeTime(target=0.95)
+        above_slow = o({"accuracy": 0.96, "time": 1e5})
+        below_fast = o({"accuracy": 0.94, "time": 0.001})
+        assert above_slow < below_fast
+        # above threshold: pure time order
+        assert o({"accuracy": 0.99, "time": 1.0}) < \
+            o({"accuracy": 0.95, "time": 2.0})
+        # below threshold: closer to target is better
+        assert o({"accuracy": 0.94, "time": 1.0}) < \
+            o({"accuracy": 0.5, "time": 1.0})
+
+    def test_nonfinite_is_inf(self):
+        assert MinimizeTime()({"time": float("nan")}) == float("inf")
+        # composites must rank ANY non-finite metric as failure too
+        o = MaximizeAccuracyMinimizeSize()
+        assert o({"accuracy": float("nan"), "size": 1.0}) == float("inf")
+        assert o({"accuracy": float("inf"), "size": 1.0}) == float("inf")
+        t = ThresholdAccuracyMinimizeTime(target=0.9)
+        assert t({"accuracy": 0.99, "time": float("nan")}) == float("inf")
+
+    def test_get_objective(self):
+        o = get_objective("ThresholdAccuracyMinimizeTime", target=0.9)
+        assert isinstance(o, ThresholdAccuracyMinimizeTime)
+        with pytest.raises(KeyError):
+            get_objective("Nope")
+
+    def test_missing_metric_message(self):
+        with pytest.raises(KeyError, match="accuracy"):
+            MaximizeAccuracy()({"time": 1.0})
+
+
+class TestRegistryAdditions:
+    def test_registered(self):
+        names = all_technique_names()
+        for n in ("AUCBanditMutationTechnique", "ComposableDiffEvolution",
+                  "ComposableDiffEvolutionCX"):
+            assert n in names, n
+
+    def test_bandit_mutation_converges_on_sphere(self):
+        from uptune_tpu.driver.driver import Tuner
+        space = _space()
+
+        def obj(cfgs):
+            return [sum(c[f"x{i}"] ** 2 for i in range(4)) + 0.01 * c["n"]
+                    for c in cfgs]
+
+        t = Tuner(space, obj, technique="AUCBanditMutationTechnique",
+                  seed=0)
+        res = t.run(test_limit=800)
+        t.close()
+        assert res.best_qor < 0.3, res.best_qor
+
+    def test_bandit_mutation_credit_moves(self):
+        space = _space()
+        bm = BanditMutation(batch=16)
+        key = jax.random.PRNGKey(0)
+        st = bm.init_state(space, key)
+        from uptune_tpu.techniques.base import Best
+        best = Best.empty(space)
+        st, cands = jax.jit(
+            lambda s, k, b: bm.propose(space, s, k, b))(st, key, best)
+        assert cands.batch == 16
+        qor = jax.numpy.linspace(0.0, 1.0, 16)
+        best = best.update(cands, qor)
+        st2 = jax.jit(
+            lambda s, c, q, b: bm.observe(space, s, c, q, b))(
+            st, cands, qor, best)
+        assert not np.allclose(np.asarray(st2.credit),
+                               np.asarray(st.credit))
+
+    def test_composable_de_perm_validity(self):
+        space = _space(with_perm=True)
+        t = ComposableDE("CX")
+        key = jax.random.PRNGKey(1)
+        st = t.init_state(space, key)
+        from uptune_tpu.techniques.base import Best
+        best = Best.empty(space)
+        for i in range(3):
+            key, k = jax.random.split(key)
+            st, cands = t.propose(space, st, k, best)
+            p = np.asarray(cands.perms[0])
+            assert (np.sort(p, 1) == np.arange(8)).all()
+            qor = jax.numpy.asarray(
+                np.random.RandomState(i).rand(cands.batch), dtype="float32")
+            best = best.update(cands, qor)
+            st = t.observe(space, st, cands, qor, best)
+
+    def test_generate_bandit_deterministic(self):
+        a = generate_bandit_technique(7)
+        b = generate_bandit_technique(7)
+        assert [t.name for t in a.techniques] == \
+            [t.name for t in b.techniques]
+        c = generate_bandit_technique(8)
+        assert [t.name for t in a.techniques] != \
+            [t.name for t in c.techniques] or len(a.techniques) != \
+            len(c.techniques)
+
+    def test_generated_portfolio_tunes(self):
+        from uptune_tpu.driver.driver import Tuner
+        space = _space()
+
+        def obj(cfgs):
+            return [sum(c[f"x{i}"] ** 2 for i in range(4)) for c in cfgs]
+
+        t = Tuner(space, obj, technique=generate_bandit_technique(3),
+                  seed=1)
+        res = t.run(test_limit=400)
+        t.close()
+        assert res.best_qor < 1.0

@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-t", "--technique", action="append", default=None,
                    help="search technique (repeatable); default: AUC "
                         "bandit portfolio")
+    p.add_argument("--generate-bandit-technique", type=int, default=None,
+                   metavar="SEED",
+                   help="use a seeded random AUC-bandit portfolio "
+                        "instead of --technique")
     p.add_argument("--seed", type=int, default=None, help="RNG seed")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
@@ -124,6 +128,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     technique = args.technique
     if technique is not None and len(technique) == 1:
         technique = technique[0]
+    if args.generate_bandit_technique is not None:
+        if technique is not None:
+            print("ut: --generate-bandit-technique conflicts with "
+                  "--technique; pass one or the other", file=sys.stderr)
+            return 2
+        from .techniques.banditmutation import generate_bandit_technique
+        technique = generate_bandit_technique(
+            args.generate_bandit_technique)
 
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,

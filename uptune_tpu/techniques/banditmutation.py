@@ -1,0 +1,214 @@
+"""Operator-level bandit mutation + composable DE + random portfolios.
+
+Covers the last three registry gaps vs the reference
+(VERDICT round 1, component #18):
+
+* `AUCBanditMutationTechnique` (`/root/reference/python/uptune/opentuner/
+  search/bandittechniques.py:204-261`): a bandit over individual
+  (parameter, operator) mutators seeded from the global best.  The
+  TPU-first redesign keeps the credit ON DEVICE: state carries an EMA
+  improvement score per operator; propose() draws one operator per
+  batch row from an epsilon-softmax over the credits, applies all
+  operator kernels to the whole batch and where-selects — one XLA
+  program, no host control flow (the random parameter choice of the
+  reference's mutator pairs is folded into the operators themselves).
+* `ComposableDiffEvolution` / `ComposableDiffEvolutionCX`
+  (`search/composableevolutionarytechniques.py:386-525`): DE whose
+  permutation handling is a composable crossover operator instead of
+  the default shuffle degeneration.
+* `--generate-bandit-technique` (`search/driver.py:71-73`,
+  `bandittechniques.py:167-201`): a seeded random AUC-bandit portfolio
+  over randomly-hyperparameterized sub-techniques.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..space.spec import CandBatch, Space
+from .base import Best, Technique, register
+from .bandit import AUCBanditMeta
+from .common import crossover_perms, mutate_batch
+
+# operator menu: (sigma, rate) mutation variants; sigma None = uniform
+# resample (the reference's op1_randomize), else normal mutation
+_OPS = (
+    (None, 0.0),      # uniform-resample one param
+    (0.01, 0.0),      # fine normal, one param
+    (0.05, 0.0),
+    (0.15, 0.0),
+    (0.30, 0.0),      # coarse normal, one param
+    (0.05, 0.25),     # normal over ~quarter of the params
+)
+N_OPS = len(_OPS)
+
+
+class BMState(NamedTuple):
+    credit: jax.Array     # [N_OPS] EMA of per-op improvement rate
+    counts: jax.Array     # [N_OPS] pulls (for reporting)
+    last_ops: jax.Array   # [B] op drawn for each row of the last batch
+
+
+class BanditMutation(Technique):
+    """Bandit-credited mutations of the global best configuration."""
+
+    def __init__(self, batch: int = 48, epsilon: float = 0.15,
+                 temperature: float = 0.1, decay: float = 0.05,
+                 name: str = "AUCBanditMutationTechnique"):
+        super().__init__(name)
+        self.batch = batch
+        self.epsilon = epsilon
+        self.temperature = temperature
+        self.decay = decay
+
+    def natural_batch(self, space: Space) -> int:
+        return self.batch
+
+    def init_state(self, space: Space, key: jax.Array) -> BMState:
+        return BMState(jnp.zeros(N_OPS), jnp.zeros(N_OPS, jnp.int32),
+                       jnp.zeros(self.batch, jnp.int32))
+
+    def propose(self, space: Space, state: BMState, key: jax.Array,
+                best: Best) -> Tuple[BMState, CandBatch]:
+        B = self.batch
+        kop, krand, *kmut = jax.random.split(key, 2 + N_OPS)
+
+        # seed from the global best; pure random until one exists
+        # (bandittechniques.py:236-244 falls back the same way)
+        have_best = jnp.isfinite(best.qor)
+        seed_batch = best.as_batch(B)
+        rand_batch = space.random(krand, B)
+        base = CandBatch(
+            jnp.where(have_best, seed_batch.u, rand_batch.u),
+            tuple(jnp.where(have_best, s, r) for s, r in
+                  zip(seed_batch.perms, rand_batch.perms)))
+
+        # epsilon-softmax draw of one operator per row
+        logits = state.credit / self.temperature
+        probs = ((1.0 - self.epsilon) * jax.nn.softmax(logits)
+                 + self.epsilon / N_OPS)
+        ops = jax.random.categorical(
+            kop, jnp.log(probs)[None, :].repeat(B, 0))      # [B]
+
+        variants_u = []
+        variants_p = []
+        for i, (sigma, rate) in enumerate(_OPS):
+            v = mutate_batch(space, kmut[i], base, rate=rate, must=1,
+                             sigma=sigma)
+            variants_u.append(v.u)
+            variants_p.append(v.perms)
+        vu = jnp.stack(variants_u)                           # [O, B, D]
+        u = jnp.take_along_axis(
+            vu, ops[None, :, None].astype(jnp.int32), axis=0)[0]
+        perms = []
+        for k_i in range(len(space.perm_sizes)):
+            vp = jnp.stack([p[k_i] for p in variants_p])     # [O, B, s]
+            perms.append(jnp.take_along_axis(
+                vp, ops[None, :, None].astype(jnp.int32), axis=0)[0])
+        counts = state.counts.at[ops].add(1)
+        return (BMState(state.credit, counts, ops.astype(jnp.int32)),
+                space.normalize(CandBatch(u, tuple(perms))))
+
+    def observe(self, space: Space, state: BMState, cands: CandBatch,
+                qor: jax.Array, best: Best) -> BMState:
+        # `best` is already updated with this batch, so a row that SET
+        # the new best satisfies qor <= best.qor
+        improved = (qor <= best.qor) & jnp.isfinite(qor)
+        # per-op improvement rate of this batch
+        onehot = jax.nn.one_hot(state.last_ops, N_OPS)       # [B, O]
+        pulls = onehot.sum(0)
+        wins = (onehot * improved[:, None]).sum(0)
+        rate = jnp.where(pulls > 0, wins / jnp.maximum(pulls, 1), 0.0)
+        touched = pulls > 0
+        credit = jnp.where(
+            touched,
+            (1.0 - self.decay) * state.credit + self.decay * rate,
+            state.credit)
+        return BMState(credit, state.counts, state.last_ops)
+
+
+# ----------------------------------------------------------------------
+class ComposableDE(Technique):
+    """DE with a composable permutation-crossover operator: numeric lanes
+    follow the standard x1 + F(x2-x3) rule via the parent class machinery;
+    permutation blocks cross parents with PX/PMX/CX/OX1/OX3 instead of
+    degenerating to a shuffle (composableevolutionarytechniques.py:386-443
+    RandomThreeParentsComposableTechnique)."""
+
+    def __init__(self, crossover: str = "OX1", population_size: int = 30,
+                 cr: float = 0.9, name: str = None):
+        super().__init__(name or f"ComposableDE-{crossover}")
+        from .de import DifferentialEvolution
+        self._de = DifferentialEvolution(
+            population_size=population_size, cr=cr, name=self.name + "~de")
+        self.crossover = crossover
+
+    def natural_batch(self, space: Space) -> int:
+        return self._de.natural_batch(space)
+
+    def init_state(self, space: Space, key: jax.Array):
+        return self._de.init_state(space, key)
+
+    def propose(self, space: Space, state, key: jax.Array, best: Best):
+        kde, kx = jax.random.split(key)
+        state, cands = self._de.propose(space, state, kde, best)
+        if space.perm_sizes:
+            # cross the proposal's perms with the current population's
+            # (child x parent crossover, the composable operator slot)
+            cands = crossover_perms(space, kx, cands, cands, state.pop,
+                                    self.crossover)
+            cands = space.normalize(cands)
+        return state, cands
+
+    def observe(self, space: Space, state, cands: CandBatch,
+                qor: jax.Array, best: Best):
+        return self._de.observe(space, state, cands, qor, best)
+
+
+# ----------------------------------------------------------------------
+def generate_bandit_technique(seed: int = 0,
+                              n_arms: int = None) -> AUCBanditMeta:
+    """Seeded random AUC-bandit portfolio (`--generate-bandit-technique`,
+    bandittechniques.py:167-201: random sub-technique count and random
+    hyperparameters)."""
+    from .annealing import PseudoAnnealingSearch
+    from .de import DifferentialEvolution
+    from .evolutionary import GlobalGA, GreedyMutation
+    from .pattern import PatternSearch
+    from .pso import PSO
+    from .simplex import NelderMead, Torczon
+
+    rng = _pyrandom.Random(seed)
+    n = n_arms or rng.randint(2, 5)
+    makers = [
+        lambda i: DifferentialEvolution(
+            population_size=rng.choice([15, 30, 50, 100]),
+            cr=rng.choice([0.2, 0.5, 0.9]), name=f"rand-de-{i}"),
+        lambda i: GreedyMutation(
+            mutation_rate=rng.choice([0.01, 0.1, 0.3]),
+            sigma=rng.choice([None, 0.05, 0.1, 0.3]),
+            crossover=rng.choice([None, "OX1", "PMX", "CX"]),
+            crossover_rate=rng.choice([0.0, 0.5, 0.8]),
+            name=f"rand-gm-{i}"),
+        lambda i: PSO(crossover=rng.choice(["OX1", "OX3", "PMX", "CX",
+                                            "PX"]),
+                      omega=rng.uniform(0.3, 0.8), name=f"rand-pso-{i}"),
+        lambda i: NelderMead(init_style=rng.choice(["random", "right"]),
+                             name=f"rand-nm-{i}"),
+        lambda i: Torczon(init_style=rng.choice(["random", "right"]),
+                          name=f"rand-tz-{i}"),
+        lambda i: PseudoAnnealingSearch(name=f"rand-sa-{i}"),
+        lambda i: PatternSearch(name=f"rand-ps-{i}"),
+        lambda i: BanditMutation(name=f"rand-bm-{i}"),
+    ]
+    members = [rng.choice(makers)(i) for i in range(n)]
+    return AUCBanditMeta(members, name=f"RandomBandit-{seed}",
+                         seed=seed)
+
+
+register(BanditMutation())
+register(ComposableDE("OX1", name="ComposableDiffEvolution"))
+register(ComposableDE("CX", name="ComposableDiffEvolutionCX"))

@@ -156,5 +156,5 @@ def _ensure_loaded():
     if _loaded:
         return
     from . import purerandom, de, evolutionary, pso, annealing  # noqa: F401
-    from . import pattern, simplex, bandit                      # noqa: F401
+    from . import pattern, simplex, bandit, banditmutation      # noqa: F401
     _loaded = True
