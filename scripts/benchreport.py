@@ -1,0 +1,247 @@
+"""Iterations-to-optimum benchmark: the north-star denominator.
+
+BASELINE.md's second target — "iterations-to-optimum <= 50% of the
+reference baseline on rosenbrock + gcc-options" — had no measured
+denominator (the reference publishes no numbers; its own protocol is a
+per-technique 30-run sweep, /root/reference/samples/rosenbrock/
+Makefile:1-30).  This harness measures both sides with our
+implementation of the reference's algorithms:
+
+* baseline mode — the reference's search stack faithfully: the default
+  AUC-bandit portfolio (same techniques, same credit math), no
+  surrogate filtering.  Iteration = one black-box evaluation, exactly
+  the reference's unit (one config per desired_result() call,
+  opentuner/search/driver.py:160-207).
+* tpu mode — the same portfolio plus the TPU-native additions: GP
+  surrogate multivoting prune (predicted-bad candidates are never
+  evaluated).
+
+Metric per run: number of EVALUATIONS until best-so-far reaches the
+space's optimum threshold (censored at the eval budget).  Reported:
+median over seeds, per space and mode, plus the tpu/baseline ratio.
+
+Spaces:
+* rosenbrock-2d / -4d — the reference's own framework-test fixture
+  (samples/rosenbrock/rosenbrock.py:1-60).
+* gcc-options-shaped — ~200 mixed params mined the way the reference
+  mines gcc (samples/gcc-options/tune_gcc.py:127-128: -O level, on/off
+  optimizer flags, numeric --param values) over a deterministic
+  synthetic runtime model with a known optimum.
+
+Usage: python scripts/benchreport.py [--seeds 30] [--quick] [--out md]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+# --------------------------------------------------------------- spaces
+def rosenbrock_problem(dim: int = 2):
+    from uptune_tpu.space.params import FloatParam
+    from uptune_tpu.space.spec import Space
+
+    space = Space([FloatParam(f"x{i}", -2.048, 2.048)
+                   for i in range(dim)])
+
+    def objective(cfgs):
+        x = np.asarray([[c[f"x{i}"] for i in range(dim)] for c in cfgs])
+        return (100.0 * (x[:, 1:] - x[:, :-1] ** 2) ** 2
+                + (1.0 - x[:, :-1]) ** 2).sum(1)
+
+    # optimum 0 at x=1; threshold: "solved" for the reference's fixture
+    return space, objective, 0.1, 4000
+
+
+def gcc_problem(n_flags: int = 120, n_params: int = 60, n_enums: int = 19,
+                fn_seed: int = 7):
+    """A gcc-options-shaped space (~200 mixed params) over a synthetic
+    runtime model: base time per -O level, per-flag effects (some only
+    active at -O2+, mirroring real pass interactions), quadratic
+    penalties for numeric --param values around hidden sweet spots, and
+    a few pairwise flag interactions.  Deterministic with a known
+    optimum by construction."""
+    from uptune_tpu.space.params import BoolParam, EnumParam, IntParam
+    from uptune_tpu.space.spec import Space
+
+    rng = np.random.RandomState(fn_seed)
+    specs = [EnumParam("olevel", ("-O0", "-O1", "-O2", "-O3"))]
+    for i in range(n_flags):
+        specs.append(BoolParam(f"f{i}"))
+    lo = rng.randint(0, 8, n_params)
+    hi = lo + rng.randint(8, 256, n_params)
+    for i in range(n_params):
+        specs.append(IntParam(f"p{i}", int(lo[i]), int(hi[i])))
+    enum_opts = ("a", "b", "c")
+    for i in range(n_enums):
+        specs.append(EnumParam(f"e{i}", enum_opts))
+    space = Space(specs)
+
+    olevel_base = np.asarray([10.0, 6.0, 4.5, 4.2])
+    w_flag = rng.randn(n_flags) * 0.25          # + hurts, - helps
+    gated = rng.rand(n_flags) < 0.3             # only active at -O2+
+    sweet = lo + (hi - lo) * rng.rand(n_params)
+    w_param = rng.rand(n_params) * 0.4 / ((hi - lo) ** 2)
+    pair_i = rng.choice(n_flags, 10, replace=False)
+    pair_j = rng.choice(n_flags, 10, replace=False)
+    w_pair = rng.randn(10) * 0.3
+    w_enum = rng.randn(n_enums, len(enum_opts)) * 0.15
+
+    def objective(cfgs):
+        out = np.empty(len(cfgs))
+        for r, c in enumerate(cfgs):
+            ol = int(c["olevel"][2])
+            flags = np.asarray([c[f"f{i}"] for i in range(n_flags)],
+                               np.float64)
+            act = flags * np.where(gated, float(ol >= 2), 1.0)
+            pv = np.asarray([c[f"p{i}"] for i in range(n_params)],
+                            np.float64)
+            ev = np.asarray(
+                [enum_opts.index(c[f"e{i}"]) for i in range(n_enums)])
+            t = olevel_base[ol]
+            t += (act * w_flag).sum()
+            t += (w_param * (pv - sweet) ** 2).sum()
+            t += (act[pair_i] * act[pair_j] * w_pair).sum()
+            t += w_enum[np.arange(n_enums), ev].sum()
+            out[r] = t
+        return out
+
+    # known optimum: best olevel with every helpful term taken.  The
+    # pairwise terms are bounded below by -|w|; use that bound (slightly
+    # loose, so the threshold sits a hair above the true optimum).
+    best = np.inf
+    for ol in range(4):
+        act_scale = np.where(gated, float(ol >= 2), 1.0)
+        t = olevel_base[ol] + np.minimum(w_flag * act_scale, 0.0).sum()
+        t -= np.abs(w_pair).sum()
+        t += w_enum.min(1).sum()
+        best = min(best, t)
+    # default config: -O0, all flags off, params at lo, enums 'a'
+    dflt = float(objective([{**{f"f{i}": False for i in range(n_flags)},
+                             **{f"p{i}": int(lo[i])
+                                for i in range(n_params)},
+                             **{f"e{i}": "a" for i in range(n_enums)},
+                             "olevel": "-O0"}])[0])
+    # threshold: capture 95% of the available improvement
+    thresh = best + 0.05 * (dflt - best)
+    return space, objective, float(thresh), 6000
+
+
+PROBLEMS = {
+    "rosenbrock-2d": lambda: rosenbrock_problem(2),
+    "rosenbrock-4d": lambda: rosenbrock_problem(4),
+    "gcc-options": gcc_problem,
+}
+
+
+# ---------------------------------------------------------------- runs
+def iters_to_threshold(trace, thresh: float, budget: int) -> int:
+    for i, v in enumerate(trace):
+        if v <= thresh:
+            return i + 1
+    return budget  # censored
+
+
+def one_run(problem: str, mode: str, seed: int, budget: int):
+    from uptune_tpu.driver.driver import Tuner
+
+    space, objective, thresh, _ = PROBLEMS[problem]()
+    surrogate = None
+    sopts = None
+    if mode == "tpu":
+        surrogate = "gp"
+        sopts = {"min_points": 48, "refit_interval": 24,
+                 "keep_quantile": 0.4, "explore_frac": 0.1,
+                 "max_points": 512}
+    tuner = Tuner(space, objective, seed=seed, surrogate=surrogate,
+                  surrogate_opts=sopts)
+    t0 = time.time()
+    res = tuner.run(test_limit=budget, target=thresh)
+    wall = time.time() - t0
+    tuner.close()
+    it = iters_to_threshold(res.trace, thresh, budget)
+    return {"iters": it, "best": res.best_qor, "evals": res.evals,
+            "wall_s": round(wall, 1),
+            "censored": it >= budget and res.best_qor > thresh}
+
+
+def run_suite(problems, seeds: int, budget_scale: float = 1.0):
+    rows = []
+    for prob in problems:
+        budget = int(PROBLEMS[prob]()[3] * budget_scale)
+        for mode in ("baseline", "tpu"):
+            per_seed = []
+            for s in range(seeds):
+                r = one_run(prob, mode, seed=1000 + s, budget=budget)
+                per_seed.append(r)
+                print(f"  {prob} {mode} seed={s} iters={r['iters']}"
+                      f"{' (censored)' if r['censored'] else ''} "
+                      f"best={r['best']:.4g} [{r['wall_s']}s]",
+                      file=sys.stderr)
+            iters = np.asarray([r["iters"] for r in per_seed])
+            rows.append({
+                "problem": prob, "mode": mode, "seeds": seeds,
+                "budget": budget,
+                "median_iters": float(np.median(iters)),
+                "iqr": [float(np.percentile(iters, 25)),
+                        float(np.percentile(iters, 75))],
+                "censored": int(sum(r["censored"] for r in per_seed)),
+            })
+            print(json.dumps(rows[-1]))
+    return rows
+
+
+def to_markdown(rows, seeds):
+    lines = [
+        "# BENCHREPORT — iterations-to-optimum",
+        "",
+        "Median evaluations until best-so-far reaches the space's",
+        "optimum threshold (rosenbrock: QoR <= 0.1; gcc-options-shaped:",
+        "95% of the default->optimum improvement).  `baseline` is the",
+        "reference's search stack run faithfully (AUC-bandit portfolio,",
+        "no surrogate); `tpu` adds GP-surrogate multivoting pruning.",
+        f"{seeds} seeds per cell.  Regenerate:",
+        "`python scripts/benchreport.py --seeds 30 --out BENCHREPORT.md`.",
+        "",
+        "| problem | mode | median iters | IQR | censored/seeds |",
+        "|---|---|---|---|---|",
+    ]
+    ratios = {}
+    for r in rows:
+        lines.append(
+            f"| {r['problem']} | {r['mode']} | {r['median_iters']:.0f} "
+            f"| {r['iqr'][0]:.0f}-{r['iqr'][1]:.0f} "
+            f"| {r['censored']}/{r['seeds']} |")
+        ratios.setdefault(r["problem"], {})[r["mode"]] = r["median_iters"]
+    lines += ["", "## Ratios (north star: tpu <= 50% of baseline)", ""]
+    for prob, m in ratios.items():
+        if "baseline" in m and "tpu" in m and m["baseline"]:
+            ratio = m["tpu"] / m["baseline"]
+            lines.append(f"* **{prob}**: {m['tpu']:.0f} / "
+                         f"{m['baseline']:.0f} = **{ratio:.2f}**")
+    lines.append("")
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import cpuenv  # noqa: F401  (hang-proof platform)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=30)
+    ap.add_argument("--quick", action="store_true",
+                    help="3 seeds, smaller budgets, rosenbrock-2d only")
+    ap.add_argument("--problems", nargs="*", default=None)
+    ap.add_argument("--out", default=None, help="write markdown here")
+    args = ap.parse_args()
+    problems = args.problems or (
+        ["rosenbrock-2d"] if args.quick else list(PROBLEMS))
+    seeds = 3 if args.quick else args.seeds
+    rows = run_suite(problems, seeds,
+                     budget_scale=0.5 if args.quick else 1.0)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(to_markdown(rows, seeds))
+        print(f"wrote {args.out}", file=sys.stderr)

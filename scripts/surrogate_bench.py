@@ -17,6 +17,7 @@ split of a synthetic 94-feature EDA-like response surface.
 """
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -124,7 +125,7 @@ def run(n: int = 600, n_feat: int = 94, n_test: int = 300,
 
 
 if __name__ == "__main__":
-    sys.path.insert(0, "scripts")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import cpuenv  # noqa: F401  (hang-proof platform for standalone runs)
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=600)

@@ -1,0 +1,129 @@
+"""EDA report extractor tests on synthetic report files (the reference
+parsers, report.py:122-174 + add/features.py:4-80, were exercised only
+against licensed-tool output)."""
+import textwrap
+
+import pytest
+
+from uptune_tpu.api import constraint as C
+from uptune_tpu.api.features import (get_syn_features, get_timing,
+                                     get_utilization, quartus, vhls)
+
+VHLS_XML = textwrap.dedent("""\
+    <profile>
+      <ReportVersion><Version>2019.1</Version></ReportVersion>
+      <UserAssignments>
+        <ProductFamily>zynq</ProductFamily>
+        <Part>xc7z020clg484-1</Part>
+        <TopModelName>top_fn</TopModelName>
+        <TargetClockPeriod>10.00</TargetClockPeriod>
+        <unit>ns</unit>
+      </UserAssignments>
+      <PerformanceEstimates>
+        <SummaryOfTimingAnalysis>
+          <EstimatedClockPeriod>8.70</EstimatedClockPeriod>
+        </SummaryOfTimingAnalysis>
+        <SummaryOfOverallLatency>
+          <Best-caseLatency>1000</Best-caseLatency>
+          <Worst-caseLatency>2000</Worst-caseLatency>
+          <Interval-min>1001</Interval-min>
+          <Interval-max>2001</Interval-max>
+        </SummaryOfOverallLatency>
+      </PerformanceEstimates>
+      <AreaEstimates>
+        <Resources>
+          <BRAM_18K>12</BRAM_18K><DSP48E>20</DSP48E>
+          <FF>4001</FF><LUT>8002</LUT>
+        </Resources>
+        <AvailableResources>
+          <BRAM_18K>280</BRAM_18K><DSP48E>220</DSP48E>
+          <FF>106400</FF><LUT>53200</LUT>
+        </AvailableResources>
+      </AreaEstimates>
+    </profile>
+""")
+
+
+@pytest.fixture(autouse=True)
+def clean(monkeypatch, tmp_path):
+    monkeypatch.delenv("UT_BEFORE_RUN_PROFILE", raising=False)
+    monkeypatch.setenv("UT_WORK_DIR", str(tmp_path))
+    C.REGISTRY.clear()
+    from uptune_tpu.api.state import STATE
+    STATE.reset()
+    yield
+    C.REGISTRY.clear()
+
+
+class TestVhls:
+    def test_parse(self, tmp_path):
+        p = tmp_path / "csynth.xml"
+        p.write_text(VHLS_XML)
+        res = vhls(str(p))
+        assert res["part"] == "xc7z020clg484-1"
+        assert res["top"] == "top_fn"
+        assert res["estimated_cp"] == pytest.approx(8.70)
+        assert res["latency_max"] == 2000
+        assert res["lut_used"] == 8002
+        assert res["lut_util_pct"] == pytest.approx(15.04)
+        assert res["dsp48e_used"] == 20
+
+    def test_target_key(self, tmp_path):
+        p = tmp_path / "csynth.xml"
+        p.write_text(VHLS_XML)
+        assert vhls(str(p), target="latency_min") == 1000
+
+    def test_register_covariates(self, tmp_path):
+        p = tmp_path / "csynth.xml"
+        p.write_text(VHLS_XML)
+        vhls(str(p), register=True)
+        assert C.REGISTRY.nodes["vhls_lut_used"].value == 8002
+
+    def test_missing_file(self):
+        with pytest.raises(RuntimeError, match="csyn"):
+            vhls("/nonexistent/report.xml")
+
+
+def _write_quartus_reports(d, design="mm"):
+    (d / f"{design}.sta.syn.summary").write_text(
+        "Type  : setup\nSlack : -0.123\nTNS : -45,6\n")
+    (d / f"{design}.syn.rpt").write_text(
+        "; boundary_port ; 42 ;\n"
+        "; fourteennm_ff ; 1,234 ;\n"
+        "; Max LUT depth ; 7.50 ;\n")
+    (d / f"{design}.fit.syn.summary").write_text(
+        "Logic utilization (in ALMs) : 1,024 / 100,000\n"
+        "Total pins : 12\n"
+        "Total RAM Blocks : 3 / 99\n")
+
+
+class TestQuartus:
+    def test_low_level_parsers(self, tmp_path):
+        _write_quartus_reports(tmp_path)
+        slack, tns = get_timing("mm", str(tmp_path), "syn")
+        assert slack == pytest.approx(-0.123)
+        assert tns == pytest.approx(-456.0)
+        syn = get_syn_features("mm", str(tmp_path))
+        assert syn["boundary_port"] == 42
+        assert syn["fourteennm_ff"] == 1234
+        assert syn["Max LUT depth"] == pytest.approx(7.5)
+        fit = get_utilization("mm", str(tmp_path), "syn")
+        assert fit["Logic utilization (in ALMs)"] == 1024
+        assert fit["Total pins"] == 12
+        assert fit["Total RAM Blocks"] == 3
+
+    def test_aggregate_and_register(self, tmp_path):
+        _write_quartus_reports(tmp_path)
+        vec = quartus("mm", str(tmp_path))
+        assert vec["slack"] == pytest.approx(-0.123)
+        assert vec["boundary_port"] == 42
+        assert C.REGISTRY.nodes["Total pins"].value == 12
+
+    def test_target_and_missing_files(self, tmp_path):
+        _write_quartus_reports(tmp_path)
+        assert quartus("mm", str(tmp_path),
+                       target="Total pins", register=False) == 12
+        # empty dir: everything missing -> empty vector, no raise
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert quartus("mm", str(empty), register=False) == {}

@@ -116,6 +116,66 @@ class TestMaskedFit:
                                    rtol=1e-3, atol=1e-4)
 
 
+class TestTopKSelect:
+    @pytest.fixture(params=["gp", "mlp"])
+    def mgr(self, request):
+        from uptune_tpu.space.params import FloatParam
+        from uptune_tpu.space.spec import Space
+        from uptune_tpu.surrogate.manager import SurrogateManager
+
+        space = Space([FloatParam("x", 0.0, 1.0),
+                       FloatParam("y", 0.0, 1.0)])
+        m = SurrogateManager(space, request.param, min_points=32,
+                             refit_interval=32, select="topk",
+                             keep_frac=0.25, explore_frac=0.0, seed=0,
+                             n_members=2)
+        rng = np.random.RandomState(0)
+        pts = rng.rand(64, 2).astype(np.float32)
+        qor = (pts ** 2).sum(1)   # minimize: best near origin
+        cands = space.from_configs(
+            [{"x": float(a), "y": float(b)} for a, b in pts])
+        m.observe(np.asarray(space.features(cands)), qor)
+        assert m.maybe_refit()
+        return space, m
+
+    def test_exactly_k_survive(self, mgr):
+        space, m = mgr
+        rng = np.random.RandomState(1)
+        pts = rng.rand(40, 2).astype(np.float32)
+        cands = space.from_configs(
+            [{"x": float(a), "y": float(b)} for a, b in pts])
+        keep = m.keep_mask(cands)
+        assert keep.sum() == 10   # 25% of 40
+
+    def test_orientation_prefers_predicted_best(self, mgr):
+        """Candidates near the origin (true minimum) must dominate the
+        kept set."""
+        space, m = mgr
+        good = np.full((20, 2), 0.05, np.float32) \
+            + np.random.RandomState(2).rand(20, 2).astype(np.float32) * 0.1
+        bad = np.full((20, 2), 0.9, np.float32)
+        pts = np.concatenate([good, bad])
+        cands = space.from_configs(
+            [{"x": float(a), "y": float(b)} for a, b in pts])
+        keep = m.keep_mask(cands)
+        assert keep[:20].sum() >= 8 and keep[20:].sum() <= 2
+
+    def test_candidate_mask_restricts_ranking(self, mgr):
+        """Ineligible (duplicate) rows must never occupy top-k slots,
+        even when their predicted scores are the best in the batch."""
+        space, m = mgr
+        good = np.full((8, 2), 0.05, np.float32)    # predicted-best rows
+        ok = np.full((32, 2), 0.5, np.float32) \
+            + np.random.RandomState(3).rand(32, 2).astype(np.float32) * 0.2
+        pts = np.concatenate([good, ok])
+        cands = space.from_configs(
+            [{"x": float(a), "y": float(b)} for a, b in pts])
+        elig = np.concatenate([np.zeros(8, bool), np.ones(32, bool)])
+        keep = m.keep_mask(cands, elig)
+        assert not keep[:8].any()
+        assert keep[8:].sum() == 8   # 25% of the 32 eligible
+
+
 class TestDatasetSanity:
     def test_train_test_share_function(self):
         """Regression guard for the benchmark itself: different sample

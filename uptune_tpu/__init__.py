@@ -36,6 +36,9 @@ _LAZY = {
     "vars": ("uptune_tpu.api.constraint", "vars"),
     "model": ("uptune_tpu.api.tuner", "model"),
     "settings": ("uptune_tpu.api.session", "settings"),
+    # EDA report extractors (reference report.py:122-174)
+    "vhls": ("uptune_tpu.api.features", "vhls"),
+    "quartus": ("uptune_tpu.api.features", "quartus"),
     # QuickEst estimator pipeline (reference __init__.py:10-43 maps
     # preprocess/train/test from uptune.quickest)
     "preprocess": ("uptune_tpu.quickest", "preprocess"),

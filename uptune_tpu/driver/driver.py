@@ -393,7 +393,7 @@ class Tuner:
 
         pruned = 0
         if n_novel and self.surrogate is not None and not injected:
-            keep = self.surrogate.keep_mask(cands)
+            keep = self.surrogate.keep_mask(cands, novel_np)
             if keep is not None:
                 pruned = int((novel_np & ~keep).sum())
                 if pruned:

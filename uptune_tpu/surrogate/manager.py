@@ -33,9 +33,20 @@ class SurrogateManager:
                  keep_quantile: float = 0.5, majority: float = 0.5,
                  explore_frac: float = 0.1, max_points: int = 1024,
                  n_members: int = 4, seed: int = 0,
-                 hyper_fit: bool = True):
+                 hyper_fit: bool = True, select: str = "threshold",
+                 keep_frac: float = 0.25):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
+        if select not in ("threshold", "topk"):
+            raise ValueError(f"unknown select mode {select!r}")
+        # select='threshold': drop candidates predicted worse than the
+        # keep_quantile of history (the reference's multivoting,
+        # api.py:307-326).  select='topk': keep only the best keep_frac
+        # of each BATCH by acquisition score — BO-style concentration,
+        # much more selective than an absolute threshold when the
+        # proposal stream is already decent.
+        self.select = select
+        self.keep_frac = keep_frac
         self.space = space
         self.kind = kind
         self.min_points = min_points
@@ -111,16 +122,37 @@ class SurrogateManager:
         return True
 
     # ------------------------------------------------------------------
-    def keep_mask(self, cands: CandBatch) -> Optional[np.ndarray]:
-        """[B] bool host mask: True = evaluate. None when not fitted."""
+    def keep_mask(self, cands: CandBatch,
+                  candidate_mask: Optional[np.ndarray] = None
+                  ) -> Optional[np.ndarray]:
+        """[B] bool host mask: True = evaluate. None when not fitted.
+        `candidate_mask` marks the rows actually eligible for evaluation
+        (novel, non-pending); topk ranks ONLY among those — otherwise
+        already-evaluated duplicate rows could fill every top-k slot and
+        starve the novel candidates."""
         if not self.fitted or self._threshold is None:
             return None
         feats = self.space.features(cands)
+        preds = None
         if self.kind == "gp":
-            lcb = np.asarray(self._score(self._state, feats))
-            keep = lcb <= self._threshold
+            score = np.asarray(self._score(self._state, feats))
         else:
             preds = np.asarray(self._score(self._state, feats))  # [E, B]
+            score = preds.mean(axis=0)
+        if self.select == "topk":
+            b = score.shape[0]
+            if candidate_mask is not None:
+                n_elig = int(np.asarray(candidate_mask).sum())
+                score = np.where(candidate_mask, score, np.inf)
+            else:
+                n_elig = b
+            k = max(1, int(round(n_elig * self.keep_frac)))
+            keep = np.zeros(b, bool)
+            if n_elig:
+                keep[np.argsort(score)[:min(k, n_elig)]] = True
+        elif self.kind == "gp":
+            keep = score <= self._threshold
+        else:
             votes = (preds <= self._threshold).mean(axis=0)
             keep = votes >= self.majority
         b = keep.shape[0]

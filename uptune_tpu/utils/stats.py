@@ -1,0 +1,185 @@
+"""Offline archive analysis: per-technique attribution + convergence.
+
+The reference answers "which technique found the best, and how fast did
+each converge" by post-hoc SQL over its results DBs
+(`/root/reference/python/uptune/opentuner/utils/stats.py`, 478 LoC of
+per-technique convergence CSV extraction + `stats_matplotlib.py`
+rendering, fed by the requestor column of every Result,
+`resultsdb/models.py:234-300`).  Our jsonl trial archive carries the
+same attribution (`tech` per row, driver/driver.py _log_trial), so the
+whole analysis is one pass over the file.
+
+CLI:  ut-stats ut.archive.jsonl [--csv out.csv] [--plot out.png]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional
+
+Row = Dict[str, Any]
+
+
+def load_archive(path: str) -> List[Row]:
+    """Read archive rows (skipping the space-signature header and any
+    torn tail line)."""
+    rows: List[Row] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                break   # torn tail
+            if "space_sig" in rec:
+                continue
+            rows.append(rec)
+    return rows
+
+
+def technique_report(rows: List[Row], sense: str = "min"
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Per-technique attribution: evals, failures, best QoR, new-best
+    count, eval index of the global best, mean eval time."""
+    sign = 1.0 if sense == "min" else -1.0
+    best_val = math.inf
+    best_tech: Optional[str] = None
+    best_idx: Optional[int] = None
+    out: Dict[str, Dict[str, Any]] = {}
+    for i, r in enumerate(rows):
+        tech = r.get("tech", "?")
+        st = out.setdefault(tech, {
+            "evals": 0, "failures": 0, "new_bests": 0,
+            "best_qor": math.inf, "time_sum": 0.0,
+            "first_eval": i, "global_best_at": None})
+        st["evals"] += 1
+        st["time_sum"] += float(r.get("time", 0.0))
+        q = float(r["qor"])
+        eng = sign * q
+        if not math.isfinite(eng):
+            st["failures"] += 1
+            continue
+        st["best_qor"] = min(st["best_qor"], eng)
+        if r.get("best"):
+            st["new_bests"] += 1
+        if eng < best_val:
+            best_val, best_tech, best_idx = eng, tech, i
+    for tech, st in out.items():
+        st["mean_time"] = (st["time_sum"] / st["evals"]
+                           if st["evals"] else 0.0)
+        del st["time_sum"]
+        st["found_global_best"] = tech == best_tech
+        if tech == best_tech:
+            st["global_best_at"] = best_idx
+        if math.isfinite(st["best_qor"]):
+            st["best_qor"] = sign * st["best_qor"]   # user orientation
+        else:
+            st["best_qor"] = None
+    return out
+
+
+def convergence(rows: List[Row], sense: str = "min"
+                ) -> Dict[str, List[List[float]]]:
+    """Per-technique best-so-far curve: [eval_index, tech_best] pairs at
+    each improvement (the per-technique convergence CSVs the reference
+    extracts, opentuner/utils/stats.py)."""
+    sign = 1.0 if sense == "min" else -1.0
+    cur: Dict[str, float] = {}
+    out: Dict[str, List[List[float]]] = {}
+    for i, r in enumerate(rows):
+        tech = r.get("tech", "?")
+        q = sign * float(r["qor"])
+        if not math.isfinite(q):
+            continue
+        if q < cur.get(tech, math.inf):
+            cur[tech] = q
+            out.setdefault(tech, []).append([i, sign * q])
+    return out
+
+
+def render_table(report: Dict[str, Dict[str, Any]]) -> str:
+    cols = ("technique", "evals", "failures", "new_bests", "best_qor",
+            "mean_time_s", "found_best")
+    lines = ["  ".join(f"{c:>14}" for c in cols)]
+    order = sorted(report, key=lambda t: -report[t]["evals"])
+    for tech in order:
+        st = report[tech]
+        bq = ("-" if st["best_qor"] is None
+              else f"{st['best_qor']:.6g}")
+        row = (tech, st["evals"], st["failures"], st["new_bests"], bq,
+               f"{st['mean_time']:.3f}",
+               "*" if st["found_global_best"] else "")
+        lines.append("  ".join(f"{str(v):>14}" for v in row))
+    return "\n".join(lines)
+
+
+def write_csv(rows: List[Row], path: str, sense: str = "min") -> None:
+    conv = convergence(rows, sense)
+    with open(path, "w") as f:
+        f.write("technique,eval_index,best_so_far\n")
+        for tech in sorted(conv):
+            for i, v in conv[tech]:
+                f.write(f"{tech},{int(i)},{v}\n")
+
+
+def plot(rows: List[Row], path: str, sense: str = "min") -> bool:
+    """Best-so-far-per-technique step plot; returns False when
+    matplotlib is unavailable (optional dependency, like the
+    reference's stats_matplotlib)."""
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        return False
+    conv = convergence(rows, sense)
+    fig, ax = plt.subplots(figsize=(8, 5))
+    for tech in sorted(conv):
+        pts = conv[tech]
+        xs = [p[0] for p in pts]
+        ys = [p[1] for p in pts]
+        ax.step(xs, ys, where="post", label=tech)
+    ax.set_xlabel("evaluation")
+    ax.set_ylabel("best QoR so far")
+    ax.legend(fontsize=7)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)
+    return True
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ut-stats",
+        description="per-technique attribution report from a jsonl "
+                    "trial archive")
+    ap.add_argument("archive")
+    ap.add_argument("--sense", choices=("min", "max"), default="min")
+    ap.add_argument("--csv", help="write per-technique convergence CSV")
+    ap.add_argument("--plot", help="write convergence plot PNG")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON")
+    args = ap.parse_args(argv)
+    rows = load_archive(args.archive)
+    if not rows:
+        print("ut-stats: empty archive", file=sys.stderr)
+        return 1
+    report = technique_report(rows, args.sense)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_table(report))
+    if args.csv:
+        write_csv(rows, args.csv, args.sense)
+    if args.plot and not plot(rows, args.plot, args.sense):
+        print("ut-stats: matplotlib unavailable; no plot",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
