@@ -1,0 +1,50 @@
+"""Library-mode usage: drive the batched Tuner directly, both with an
+in-process objective and externally paced via ask()/tell() — the
+counterpart of the reference's TuningRunManager example
+(/root/reference/samples/py_api/api_example.py and
+opentuner/api.py:18-53 get_next_desired_result/report_result).
+
+Run:  python samples/py_api/api_example.py
+"""
+import sys
+
+
+def main():
+    from uptune_tpu.driver.driver import Tuner
+    from uptune_tpu.space.params import EnumParam, FloatParam, IntParam
+    from uptune_tpu.space.spec import Space
+
+    space = Space([
+        FloatParam("alpha", 0.0, 1.0),
+        IntParam("block", 1, 64),
+        EnumParam("opt", ("O0", "O1", "O2", "O3")),
+    ])
+
+    def objective(cfgs):
+        return [
+            (c["alpha"] - 0.8) ** 2 * 10
+            + (c["block"] - 32) ** 2 / 64.0
+            + {"O0": 2.0, "O1": 1.0, "O2": 0.5, "O3": 0.0}[c["opt"]]
+            for c in cfgs
+        ]
+
+    # 1. in-process loop (measurement-interface style)
+    tuner = Tuner(space, objective, seed=0)
+    res = tuner.run(test_limit=300)
+    tuner.close()
+    print("in-process best:", res.best_config, f"qor={res.best_qor:.4f}")
+
+    # 2. ask/tell: evaluation paced by external machinery
+    tuner = Tuner(space, seed=1)
+    for _ in range(10):
+        trials = tuner.ask(min_trials=8)
+        for tr in trials:
+            tuner.tell(tr, objective([tr.config])[0])
+    res = tuner.result()
+    tuner.close()
+    print("ask/tell best:  ", res.best_config, f"qor={res.best_qor:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Rosenbrock — the reference's framework-test fixture
+(/root/reference/samples/rosenbrock/rosenbrock.py:1-60) in intrusive
+form.
+
+    ut samples/rosenbrock/rosenbrock.py -pf 2 --test-limit 200
+
+For the in-process (library-mode) equivalent with per-technique sweeps,
+see scripts/benchreport.py and samples/py_api/api_example.py.
+"""
+import uptune_tpu as ut
+
+DIM = 4
+x = [ut.tune(0.0, (-2.048, 2.048), name=f"x{i}") for i in range(DIM)]
+
+val = sum(100.0 * (x[i + 1] - x[i] ** 2) ** 2 + (1.0 - x[i]) ** 2
+          for i in range(DIM - 1))
+ut.target(val, "min")
+print("rosenbrock:", val)

@@ -1,0 +1,25 @@
+"""Travelling salesman over a permutation parameter — the reference's
+tsp sample (/root/reference/samples/tsp/tsp.py:1-19): tune the city
+tour, evaluate the closed-tour length on a fixed distance matrix.
+
+    ut samples/tsp/tsp.py -pf 2 --test-limit 300
+"""
+import math
+
+import uptune_tpu as ut
+
+N = 12
+# deterministic city ring with noise: optimum is (near) the ring order
+CITIES = [(math.cos(2 * math.pi * i / N) + 0.013 * ((i * 7919) % 10),
+           math.sin(2 * math.pi * i / N) + 0.013 * ((i * 104729) % 10))
+          for i in range(N)]
+
+tour = ut.tune(list(range(N)), list(range(N)), name="tour")
+
+length = 0.0
+for a, b in zip(tour, tour[1:] + tour[:1]):
+    (x1, y1), (x2, y2) = CITIES[a], CITIES[b]
+    length += math.hypot(x2 - x1, y2 - y1)
+
+ut.target(length, "min")
+print("tour length:", length)

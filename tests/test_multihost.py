@@ -1,0 +1,69 @@
+"""Multi-host bootstrap helpers.  Real DCN needs multiple processes;
+here we verify the config resolution/validation layer and the mesh
+layout contract on the virtual 8-device CPU platform (full sharded
+execution is covered by tests/test_engine.py and the driver's
+dryrun_multichip)."""
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from uptune_tpu.parallel import (distributed_config,  # noqa: E402
+                                 is_coordinator, make_multihost_mesh)
+
+
+class TestConfig:
+    def test_single_process_defaults(self, monkeypatch):
+        for v in ("UT_COORDINATOR", "UT_NUM_PROCESSES", "UT_PROCESS_ID"):
+            monkeypatch.delenv(v, raising=False)
+        cfg = distributed_config()
+        assert cfg == {"coordinator_address": None, "num_processes": 1,
+                       "process_id": 0}
+
+    def test_env_layer(self, monkeypatch):
+        monkeypatch.setenv("UT_COORDINATOR", "10.0.0.1:1234")
+        monkeypatch.setenv("UT_NUM_PROCESSES", "4")
+        monkeypatch.setenv("UT_PROCESS_ID", "2")
+        cfg = distributed_config()
+        assert cfg["coordinator_address"] == "10.0.0.1:1234"
+        assert cfg["num_processes"] == 4 and cfg["process_id"] == 2
+
+    def test_args_beat_env(self, monkeypatch):
+        monkeypatch.setenv("UT_NUM_PROCESSES", "4")
+        monkeypatch.setenv("UT_COORDINATOR", "env:1")
+        cfg = distributed_config("arg:2", 8, 7)
+        assert cfg["coordinator_address"] == "arg:2"
+        assert cfg["num_processes"] == 8 and cfg["process_id"] == 7
+
+    def test_validation(self, monkeypatch):
+        for v in ("UT_COORDINATOR", "UT_NUM_PROCESSES", "UT_PROCESS_ID"):
+            monkeypatch.delenv(v, raising=False)
+        with pytest.raises(ValueError, match="coordinator"):
+            distributed_config(num_processes=2)
+        with pytest.raises(ValueError, match="outside"):
+            distributed_config("h:1", 2, 5)
+        with pytest.raises(ValueError, match=">= 1"):
+            distributed_config(num_processes=0)
+
+
+class TestMesh:
+    def test_layout(self):
+        mesh = make_multihost_mesh(n_eval_per_host=2)
+        n = len(jax.devices())
+        assert dict(mesh.shape) == {"search": n // 2, "eval": 2}
+        # eval rows are contiguous device ids (the ICI-island contract)
+        ids = [[d.id for d in row] for row in mesh.devices]
+        for row in ids:
+            assert row == sorted(row)
+            assert row[1] == row[0] + 1
+
+    def test_indivisible(self):
+        with pytest.raises(ValueError, match="divid"):
+            make_multihost_mesh(n_eval_per_host=3)
+
+    def test_eval_wider_than_host_rejected(self):
+        n = len(jax.devices())
+        with pytest.raises(ValueError, match="divid"):
+            make_multihost_mesh(n_eval_per_host=n * 2)
+
+    def test_coordinator_predicate(self):
+        assert is_coordinator() is True   # single-process run

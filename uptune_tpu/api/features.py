@@ -99,15 +99,19 @@ def get_timing(design: str, workdir: str,
                stage: str) -> Tuple[Any, Any]:
     """(slack, tns) from {design}.sta.{stage}.summary
     (add/features.py:4-17); 'None' entries become 0."""
+    def numeric(text: str) -> Any:
+        v = _num(text)
+        return 0 if isinstance(v, str) else v   # 'None' etc. -> 0
+
     slack: Any = 0
     tns: Any = 0
     path = os.path.join(workdir, f"{design}.sta.{stage}.summary")
     with open(path) as f:
         for line in f:
             if "Slack" in line:
-                slack = _num(line.split(":")[-1])
+                slack = numeric(line.split(":")[-1])
             elif "TNS" in line:
-                tns = _num(line.split(":")[-1])
+                tns = numeric(line.split(":")[-1])
                 break
     return slack, tns
 

@@ -75,6 +75,7 @@ class ProgramTuner:
                        else c for c in command]
         self.command = command
         self.work_dir = os.path.abspath(work_dir or os.getcwd())
+        os.makedirs(self.work_dir, exist_ok=True)
         self.parallel = int(parallel if parallel is not None
                             else settings["parallel-factor"])
         self.test_limit = int(test_limit if test_limit is not None

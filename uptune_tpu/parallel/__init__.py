@@ -1,1 +1,3 @@
+from .multihost import (distributed_config, initialize,  # noqa: F401
+                        is_coordinator, make_multihost_mesh)
 from .sharded import ShardedEngine, make_mesh  # noqa: F401

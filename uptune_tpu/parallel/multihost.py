@@ -1,0 +1,106 @@
+"""Multi-host (DCN) scale-out: jax.distributed bootstrap + hybrid
+meshes.
+
+The reference reaches multiple machines through a Ray cluster + shared
+FS / S3 (`/root/reference/cluster/config.yaml:1-60`,
+`api.py:831-848` node workdir discovery,
+`async_task_scheduler.py:340-353` S3 publish).  The TPU-native
+equivalent is the standard JAX multi-process model: every host runs the
+same program, `jax.distributed.initialize` wires the processes over
+DCN, and the ('search', 'eval') mesh of `uptune_tpu.parallel.sharded`
+is laid out so that the *search* axis (the best-exchange collective,
+tiny payloads, latency-tolerant) spans hosts over DCN while the *eval*
+axis (per-replica batch sharding, bandwidth-sensitive) stays inside
+each host's ICI island — the layout recipe of the scaling playbook:
+fast collectives ride ICI, slow ones ride DCN.
+
+Environment-variable bootstrap mirrors the reference's settings-dict
+override layering (flags > env > defaults): UT_COORDINATOR,
+UT_NUM_PROCESSES, UT_PROCESS_ID.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from jax.sharding import Mesh
+
+
+def distributed_config(coordinator: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> dict:
+    """Resolve the jax.distributed bootstrap triple from args > UT_* env
+    > single-process defaults; validates before any network call."""
+    coordinator = coordinator or os.environ.get("UT_COORDINATOR")
+    if num_processes is None:
+        env = os.environ.get("UT_NUM_PROCESSES")
+        num_processes = int(env) if env else 1
+    if process_id is None:
+        env = os.environ.get("UT_PROCESS_ID")
+        process_id = int(env) if env else 0
+    if num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    if not 0 <= process_id < num_processes:
+        raise ValueError(
+            f"process_id {process_id} outside [0, {num_processes})")
+    if num_processes > 1 and not coordinator:
+        raise ValueError(
+            "multi-process run needs a coordinator address "
+            "(UT_COORDINATOR=host:port or coordinator=...)")
+    return {"coordinator_address": coordinator,
+            "num_processes": num_processes, "process_id": process_id}
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> dict:
+    """Bootstrap jax.distributed for a multi-host tuning run; no-op for
+    a single process.  Returns the resolved config."""
+    cfg = distributed_config(coordinator, num_processes, process_id)
+    if cfg["num_processes"] > 1:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=cfg["coordinator_address"],
+            num_processes=cfg["num_processes"],
+            process_id=cfg["process_id"])
+    return cfg
+
+
+def make_multihost_mesh(n_eval_per_host: int = 1,
+                        devices: Optional[Sequence] = None) -> Mesh:
+    """('search', 'eval') mesh spanning every process's devices.
+
+    Layout contract: devices of one host stay CONTIGUOUS along the
+    search axis and the eval axis never crosses a host boundary, so the
+    eval all_gather runs on ICI and only the (scalar) best-exchange
+    crosses DCN.  jax.devices() in a multi-process run returns all
+    global devices grouped by process, which gives exactly that
+    ordering."""
+    import jax
+    import numpy as np
+
+    if devices is None:
+        # the eval axis must fit inside one host's ICI island
+        local = jax.local_device_count()
+        if local % n_eval_per_host:
+            raise ValueError(
+                f"eval width {n_eval_per_host} does not divide the "
+                f"{local} local devices — the eval all_gather would "
+                f"cross a host boundary onto DCN")
+        devices = list(jax.devices())
+    else:
+        devices = list(devices)
+    n = len(devices)
+    if n % n_eval_per_host:
+        raise ValueError(
+            f"{n} global devices not divisible by eval width "
+            f"{n_eval_per_host}")
+    arr = np.array(devices).reshape(n // n_eval_per_host, n_eval_per_host)
+    return Mesh(arr, ("search", "eval"))
+
+
+def is_coordinator() -> bool:
+    """True on the process that should own host-side IO (archive writes,
+    best.json, logging) — process_id 0."""
+    import jax
+    return jax.process_index() == 0

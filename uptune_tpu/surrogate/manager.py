@@ -159,4 +159,6 @@ class SurrogateManager:
         self._key, ke = jax.random.split(self._key)
         explore = np.asarray(
             jax.random.uniform(ke, (b,))) < self.explore_frac
+        if candidate_mask is not None:
+            explore = explore & np.asarray(candidate_mask)
         return keep | explore

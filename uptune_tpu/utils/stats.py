@@ -27,17 +27,25 @@ def load_archive(path: str) -> List[Row]:
     torn tail line)."""
     rows: List[Row] = []
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                break   # torn tail
-            if "space_sig" in rec:
-                continue
-            rows.append(rec)
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                break   # torn tail write: expected, drop silently
+            # mid-file junk (e.g. a torn line later appended over):
+            # skip THIS line only — dropping the rest would silently
+            # falsify attribution counts
+            print(f"ut-stats: skipping corrupt line {i + 1} of {path}",
+                  file=sys.stderr)
+            continue
+        if "space_sig" in rec:
+            continue
+        rows.append(rec)
     return rows
 
 
