@@ -1,0 +1,123 @@
+"""Selector + array parameter types (the reference's
+SelectorParameter / ParameterArray / BooleanArray / FloatArray,
+manipulator.py:1448-1732, redesigned as ordered INT lanes and
+lane-expanded composites)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from uptune_tpu.exec.space_io import space_from_params  # noqa: E402
+from uptune_tpu.space import (BoolArrayParam, FloatArrayParam,  # noqa: E402
+                              FloatParam, IntArrayParam, SelectorParam,
+                              Space)
+
+
+class TestSelector:
+    def test_choice_mapping_ordered(self):
+        s = SelectorParam("s", ("a", "b", "c"), max_cutoff=9)
+        # positions 0-2 -> a, 3-5 -> b, 6-8 -> c
+        assert [s.choice_of(p) for p in range(9)] == \
+            ["a", "a", "a", "b", "b", "b", "c", "c", "c"]
+
+    def test_round_trip(self):
+        space = Space([SelectorParam("s", ("x", "y", "z"), 12)])
+        cands = space.from_configs([{"s": "y"}, {"s": "z"}, {"s": "x"}])
+        cfgs = space.to_configs(cands)
+        assert [c["s"] for c in cfgs] == ["y", "z", "x"]
+
+    def test_locality_under_mutation(self):
+        """Small unit-space steps move to the same or a neighboring
+        choice (the property an ENUM lane does not have)."""
+        s = SelectorParam("s", ("a", "b", "c", "d"), 16)
+        space = Space([s])
+        import jax.numpy as jnp
+        u = jnp.linspace(0.02, 0.98, 50)[:, None]
+        vals = space.decode_scalars_np(np.asarray(u))[:, 0]
+        seq = [s.choice_of(int(round(v))) for v in vals]
+        order = [seq[0]]
+        for c in seq[1:]:
+            if c != order[-1]:
+                order.append(c)
+        assert order == ["a", "b", "c", "d"]   # monotone sweep
+
+    def test_tunes(self):
+        from uptune_tpu.driver.driver import Tuner
+        space = Space([SelectorParam("alg", ("slow", "ok", "fast"), 9),
+                       FloatParam("x", 0.0, 1.0)])
+        cost = {"slow": 2.0, "ok": 1.0, "fast": 0.0}
+
+        def obj(cfgs):
+            return [cost[c["alg"]] + (c["x"] - 0.5) ** 2 for c in cfgs]
+
+        t = Tuner(space, obj, seed=0)
+        res = t.run(test_limit=300)
+        t.close()
+        assert res.best_config["alg"] == "fast"
+
+
+class TestArrays:
+    def test_expansion_and_round_trip(self):
+        space = Space([BoolArrayParam("flags", 4),
+                       IntArrayParam("tiles", 3, 1, 8),
+                       FloatArrayParam("w", 2, -1.0, 1.0)])
+        assert space.n_scalar == 9
+        cfg = {"flags": [True, False, True, False],
+               "tiles": [2, 8, 1], "w": [0.25, -0.5]}
+        out = space.to_configs(space.from_configs([cfg]))[0]
+        assert out["flags"] == cfg["flags"]
+        assert out["tiles"] == cfg["tiles"]
+        np.testing.assert_allclose(out["w"], cfg["w"], atol=1e-3)
+
+    def test_wrong_length_rejected(self):
+        space = Space([BoolArrayParam("f", 3)])
+        with pytest.raises(ValueError, match="3 elements"):
+            space.from_configs([{"f": [True]}])
+
+    def test_expansion_name_collision_rejected(self):
+        from uptune_tpu.space import IntParam
+        with pytest.raises(ValueError, match="collide"):
+            Space([IntParam("x[0]", 0, 5), IntArrayParam("x", 2, 0, 5)])
+
+    def test_search_space_size(self):
+        assert BoolArrayParam("f", 5).search_space_size() == 32.0
+        assert IntArrayParam("t", 2, 0, 9).search_space_size() == 100.0
+
+    def test_random_and_hash(self):
+        space = Space([BoolArrayParam("f", 4),
+                       FloatParam("x", 0.0, 1.0)])
+        cands = space.random(jax.random.PRNGKey(0), 16)
+        h = space.hash_batch(cands)
+        assert h.shape[0] == 16
+        cfgs = space.to_configs(cands)
+        assert all(len(c["f"]) == 4 for c in cfgs)
+
+    def test_tunes(self):
+        from uptune_tpu.driver.driver import Tuner
+        space = Space([BoolArrayParam("f", 6)])
+        want = [True, False, True, True, False, True]
+
+        def obj(cfgs):
+            return [sum(a != b for a, b in zip(c["f"], want))
+                    for c in cfgs]
+
+        t = Tuner(space, obj, seed=0)
+        res = t.run(test_limit=400)
+        t.close()
+        assert res.best_qor == 0.0
+        assert res.best_config["f"] == want
+
+
+class TestSpaceIO:
+    def test_records(self):
+        space = space_from_params([
+            {"name": "s", "type": "selector", "choices": ["a", "b"],
+             "max_cutoff": 6},
+            {"name": "f", "type": "bool_array", "n": 3},
+            {"name": "t", "type": "int_array", "n": 2, "lo": 0, "hi": 7},
+            {"name": "w", "type": "float_array", "n": 2, "lo": 0.0,
+             "hi": 1.0},
+        ])
+        assert space.n_scalar == 1 + 3 + 2 + 2
+        cfg = space.to_configs(space.random(jax.random.PRNGKey(1), 2))[0]
+        assert cfg["s"] in ("a", "b") and len(cfg["f"]) == 3

@@ -37,6 +37,17 @@ def _spec_from_record(rec: Dict[str, Any]) -> P.ParamSpec:
         return P.LogFloatParam(name, float(rec["lo"]), float(rec["hi"]))
     if kind == "pow2":
         return P.Pow2Param(name, int(rec["lo"]), int(rec["hi"]))
+    if kind == "selector":
+        return P.SelectorParam(name, tuple(rec["choices"]),
+                               int(rec.get("max_cutoff", 0)))
+    if kind == "bool_array":
+        return P.BoolArrayParam(name, int(rec["n"]))
+    if kind == "int_array":
+        return P.IntArrayParam(name, int(rec["n"]), int(rec["lo"]),
+                               int(rec["hi"]))
+    if kind == "float_array":
+        return P.FloatArrayParam(name, int(rec["n"]), float(rec["lo"]),
+                                 float(rec["hi"]))
     raise ValueError(f"unknown param record type {kind!r} for {name!r}")
 
 

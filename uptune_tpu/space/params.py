@@ -327,6 +327,107 @@ class ScheduleParam(PermParam):
         return mat
 
 
+@dataclass(frozen=True)
+class SelectorParam(_ScalarSpec):
+    """Ordered choice: an integer position in [0, max_cutoff) mapped onto
+    `choices` by equal intervals.  The reference's SelectorParameter
+    (manipulator.py:1448-1484) searches over explicit cutoff lists; the
+    TPU-first simplification keeps its essential property — ADJACENT
+    positions map to the same or neighboring choice, so ordinary numeric
+    mutation moves between related choices — in one INT lane with fixed
+    interval boundaries."""
+    choices: Tuple[Any, ...] = ()
+    max_cutoff: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "choices", tuple(self.choices))
+        assert len(self.choices) >= 1, self.name
+        mc = self.max_cutoff or len(self.choices)
+        object.__setattr__(self, "max_cutoff", int(mc))
+        assert self.max_cutoff >= len(self.choices), self.name
+
+    @property
+    def kind(self) -> int:
+        return INT   # ordered lane, NOT complex: locality is the point
+
+    def scaled_range(self):
+        return -0.4999, self.max_cutoff - 1 + 0.4999
+
+    def choice_of(self, pos: int) -> Any:
+        i = int(pos) * len(self.choices) // self.max_cutoff
+        return self.choices[min(max(i, 0), len(self.choices) - 1)]
+
+    def pos_of(self, choice: Any) -> int:
+        i = self.choices.index(choice)
+        # center of the choice's interval
+        return min((2 * i + 1) * self.max_cutoff // (2 * len(self.choices)),
+                   self.max_cutoff - 1)
+
+    def search_space_size(self):
+        return float(self.max_cutoff)
+
+
+class ArrayParam(ParamSpec):
+    """Base for fixed-length array parameters (manipulator.py:1484-1732
+    ParameterArray / BooleanArray / FloatArray / Array): expands into n
+    scalar lanes named ``name[i]`` at Space build time; the config value
+    is one Python list."""
+
+    name: str
+    n: int
+
+    def expand(self) -> List[_ScalarSpec]:
+        raise NotImplementedError
+
+    def search_space_size(self) -> float:
+        out = 1.0
+        for s in self.expand():
+            out *= s.search_space_size()
+        return out
+
+
+@dataclass(frozen=True)
+class BoolArrayParam(ArrayParam):
+    name: str = ""
+    n: int = 1
+
+    def __post_init__(self):
+        assert self.n >= 1, self.name
+
+    def expand(self):
+        return [BoolParam(f"{self.name}[{i}]") for i in range(self.n)]
+
+
+@dataclass(frozen=True)
+class IntArrayParam(ArrayParam):
+    name: str = ""
+    n: int = 1
+    lo: int = 0
+    hi: int = 1
+
+    def __post_init__(self):
+        assert self.n >= 1, self.name
+
+    def expand(self):
+        return [IntParam(f"{self.name}[{i}]", lo=self.lo, hi=self.hi)
+                for i in range(self.n)]
+
+
+@dataclass(frozen=True)
+class FloatArrayParam(ArrayParam):
+    name: str = ""
+    n: int = 1
+    lo: float = 0.0
+    hi: float = 1.0
+
+    def __post_init__(self):
+        assert self.n >= 1, self.name
+
+    def expand(self):
+        return [FloatParam(f"{self.name}[{i}]", lo=self.lo, hi=self.hi)
+                for i in range(self.n)]
+
+
 def infer_param(name: str, default: Any, space: Any) -> ParamSpec:
     """Infer a ParamSpec from a `ut.tune(default, space)` call, mirroring the
     type-dispatch of the reference's tune API

@@ -74,10 +74,26 @@ class Space:
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate parameter names: {names}")
         self.specs: Tuple[P.ParamSpec, ...] = tuple(specs)
+        # ArrayParams expand into scalar lanes named "name[i]"; the
+        # grouping map reassembles them into one list config value
+        expanded: List[P.ParamSpec] = []
+        self.array_groups: Dict[str, List[str]] = {}
+        for s in specs:
+            if isinstance(s, P.ArrayParam):
+                children = s.expand()
+                self.array_groups[s.name] = [c.name for c in children]
+                expanded.extend(children)
+            else:
+                expanded.append(s)
+        exp_names = [s.name for s in expanded]
+        if len(set(exp_names)) != len(exp_names):
+            dups = sorted({n for n in exp_names if exp_names.count(n) > 1})
+            raise ValueError(
+                f"parameter names collide after array expansion: {dups}")
         self.scalars: Tuple[P._ScalarSpec, ...] = tuple(
-            s for s in specs if not s.is_permutation)
+            s for s in expanded if not s.is_permutation)
         self.perm_specs: Tuple[P.PermParam, ...] = tuple(
-            s for s in specs if s.is_permutation)
+            s for s in expanded if s.is_permutation)
         self.name_to_spec = {s.name: s for s in specs}
 
         D = len(self.scalars)
@@ -90,8 +106,10 @@ class Space:
             kind[i] = s.kind
             a, b = s.scaled_range()
             slo[i], shi[i] = a, b
-            if isinstance(s, (P.FloatParam, P.IntParam, P.LogFloatParam,
-                              P.LogIntParam)):
+            if isinstance(s, P.SelectorParam):
+                vlo[i], vhi[i] = 0, s.max_cutoff - 1
+            elif isinstance(s, (P.FloatParam, P.IntParam, P.LogFloatParam,
+                                P.LogIntParam)):
                 vlo[i], vhi[i] = float(s.lo), float(s.hi)
             elif isinstance(s, P.Pow2Param):
                 vlo[i], vhi[i] = s.exp_lo, s.exp_hi  # exponent bounds
@@ -323,7 +341,9 @@ class Space:
             cfg: Dict[str, Any] = {}
             for i, s in enumerate(self.scalars):
                 v = vals[b, i]
-                if isinstance(s, P.FloatParam) or isinstance(s, P.LogFloatParam):
+                if isinstance(s, P.SelectorParam):
+                    cfg[s.name] = s.choice_of(int(round(float(v))))
+                elif isinstance(s, P.FloatParam) or isinstance(s, P.LogFloatParam):
                     cfg[s.name] = float(v)
                 elif isinstance(s, P.EnumParam):
                     cfg[s.name] = s.options[int(round(float(v)))]
@@ -333,6 +353,8 @@ class Space:
                     cfg[s.name] = int(round(float(v)))
             for k, s in enumerate(self.perm_specs):
                 cfg[s.name] = [s.items[int(i)] for i in perms[k][b]]
+            for parent, children in self.array_groups.items():
+                cfg[parent] = [cfg.pop(c) for c in children]
             out.append(cfg)
         return out
 
@@ -348,11 +370,26 @@ class Space:
         where an occasional duplicate evaluation is harmless.
         """
         B = len(cfgs)
+        if self.array_groups:
+            flat = []
+            for cfg in cfgs:
+                cfg = dict(cfg)
+                for parent, children in self.array_groups.items():
+                    seq = cfg.pop(parent)
+                    if len(seq) != len(children):
+                        raise ValueError(
+                            f"array {parent!r} needs {len(children)} "
+                            f"elements, got {len(seq)}")
+                    cfg.update(zip(children, seq))
+                flat.append(cfg)
+            cfgs = flat
         vals = np.zeros((B, self.n_scalar), np.float64)
         for b, cfg in enumerate(cfgs):
             for i, s in enumerate(self.scalars):
                 v = cfg[s.name]
-                if isinstance(s, P.EnumParam):
+                if isinstance(s, P.SelectorParam):
+                    vals[b, i] = s.pos_of(v)
+                elif isinstance(s, P.EnumParam):
                     vals[b, i] = s.options.index(v)
                 elif isinstance(s, P.BoolParam):
                     vals[b, i] = float(bool(v))

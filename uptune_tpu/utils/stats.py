@@ -26,26 +26,27 @@ def load_archive(path: str) -> List[Row]:
     """Read archive rows (skipping the space-signature header and any
     torn tail line)."""
     rows: List[Row] = []
+    bad_line = None   # one-line lookbehind: junk is only OK at EOF
     with open(path) as f:
-        lines = f.readlines()
-    for i, line in enumerate(lines):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            if i == len(lines) - 1:
-                break   # torn tail write: expected, drop silently
-            # mid-file junk (e.g. a torn line later appended over):
-            # skip THIS line only — dropping the rest would silently
-            # falsify attribution counts
-            print(f"ut-stats: skipping corrupt line {i + 1} of {path}",
-                  file=sys.stderr)
-            continue
-        if "space_sig" in rec:
-            continue
-        rows.append(rec)
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            if bad_line is not None:
+                # the junk was mid-file, not a torn tail: skip THAT line
+                # only — dropping the rest would silently falsify
+                # attribution counts
+                print(f"ut-stats: skipping corrupt line {bad_line} of "
+                      f"{path}", file=sys.stderr)
+                bad_line = None
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad_line = lineno
+                continue
+            if "space_sig" in rec:
+                continue
+            rows.append(rec)
     return rows
 
 
