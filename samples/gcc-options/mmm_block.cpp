@@ -1,0 +1,34 @@
+// Blocked matrix multiply — the tuning payload of the reference's
+// getting-started tutorial (/root/reference/samples/tutorials/
+// gettingstarted.md: tune BLOCK_SIZE + gcc flags on mmm_block.cpp).
+#include <cstdio>
+
+#ifndef BLOCK_SIZE
+#define BLOCK_SIZE 16
+#endif
+#define N 420
+
+static double A[N][N], B[N][N], C[N][N];
+
+int main() {
+  for (int i = 0; i < N; ++i)
+    for (int j = 0; j < N; ++j) {
+      A[i][j] = (i + j) % 7;
+      B[i][j] = (i * j) % 13;
+      C[i][j] = 0.0;
+    }
+  for (int ii = 0; ii < N; ii += BLOCK_SIZE)
+    for (int kk = 0; kk < N; kk += BLOCK_SIZE)
+      for (int jj = 0; jj < N; jj += BLOCK_SIZE)
+        for (int i = ii; i < (ii + BLOCK_SIZE < N ? ii + BLOCK_SIZE : N);
+             ++i)
+          for (int k = kk; k < (kk + BLOCK_SIZE < N ? kk + BLOCK_SIZE : N);
+               ++k)
+            for (int j = jj;
+                 j < (jj + BLOCK_SIZE < N ? jj + BLOCK_SIZE : N); ++j)
+              C[i][j] += A[i][k] * B[k][j];
+  double sum = 0.0;
+  for (int i = 0; i < N; ++i) sum += C[i][i];
+  std::printf("checksum %.1f\n", sum);
+  return 0;
+}

@@ -1,0 +1,50 @@
+"""Tune real g++ flags + block size on a blocked matmul — the shape of
+the reference's gcc-options sample (/root/reference/samples/gcc-options/
+tune_gcc.py: -O level, on/off optimizer flags, numeric params) on the
+tutorial's mmm_block payload, small enough to run anywhere g++ exists.
+
+    ut samples/gcc-options/tune_gcc.py -pf 2 --test-limit 30 \
+        --runtime-limit 60
+
+QoR = best-of-3 wall time of the compiled binary (seconds); failed
+compiles report +inf and count as failures.
+"""
+import math
+import os
+import subprocess
+import tempfile
+import time
+
+import uptune_tpu as ut
+
+olevel = ut.tune("-O2", ["-O0", "-O1", "-O2", "-O3"], name="olevel")
+FLAGS = ("-funroll-loops", "-ftree-vectorize", "-ffast-math",
+         "-fomit-frame-pointer", "-finline-functions")
+enabled = [ut.tune(False, name=f) for f in FLAGS]
+block = ut.tune(16, (4, 128), name="block_size")
+
+src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "mmm_block.cpp")
+exe = tempfile.NamedTemporaryFile(suffix=".bin", delete=False).name
+cmd = (["g++", olevel, f"-DBLOCK_SIZE={block}"]
+       + [f for f, on in zip(FLAGS, enabled) if on]
+       + [src, "-o", exe])
+
+try:
+    cc = subprocess.run(cmd, capture_output=True, timeout=120)
+    if cc.returncode != 0:
+        ut.target(math.inf, "min")      # compile failure
+    else:
+        best = math.inf
+        for _ in range(3):
+            t0 = time.perf_counter()
+            subprocess.run([exe], capture_output=True, timeout=60,
+                           check=True)
+            best = min(best, time.perf_counter() - t0)
+        ut.target(best, "min")
+        print(f"{olevel} block={block} "
+              f"flags={[f for f, on in zip(FLAGS, enabled) if on]} "
+              f"t={best:.4f}s")
+finally:
+    if os.path.exists(exe):
+        os.unlink(exe)

@@ -79,6 +79,22 @@ class TestMLL:
         assert float(base) == pytest.approx(float(padded), rel=1e-4)
 
 
+class TestKernelNumerics:
+    def test_self_diagonal_is_one_at_small_lengthscale(self):
+        """The matmul-identity distance must not lose the |a-b|=0
+        cancellation: K(x, x) diagonal stays 1.0 even when |x/ls|^2 is
+        large.  (On TPU this requires precision='highest' — default
+        bf16 matmul passes collapsed the diagonal to ~0.0002 at
+        ls=0.05; CPU f32 hides the bug, but the assertion documents
+        the contract wherever the suite runs.)"""
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(256, 94), jnp.float32)
+        for ls in (0.05, 0.3, 2.0):
+            k = gp._matern52(x, x, jnp.float32(ls))
+            diag = np.asarray(jnp.diagonal(k))
+            assert diag.min() > 0.99, (ls, diag.min())
+
+
 class TestMaskedFit:
     def test_gp_padding_exact(self):
         """fit() on padded+masked data must produce the same predictions
