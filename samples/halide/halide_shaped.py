@@ -1,0 +1,71 @@
+"""Halide-schedule-shaped tuning — the space structure of the
+reference's halide sample (/root/reference/samples/halide/
+halidetuner.py:122-489: a dependency-respecting ScheduleParameter over
+pipeline stages plus per-stage tiling/vectorization knobs) over a
+deterministic synthetic cost model, so it runs without a Halide
+toolchain.
+
+The pipeline: in -> blur_x -> blur_y -> sharpen -> out, with a schedule
+ordering constrained by those dependencies (ScheduleParam topologically
+normalizes every candidate) and pow2 tile/vector widths per hot stage.
+Cost rewards producer-consumer locality (adjacent stages scheduled
+close together) and a sweet-spot tile configuration.
+
+    python samples/halide/halide_shaped.py          # library mode
+"""
+import sys
+
+
+def main():
+    from uptune_tpu.driver.driver import Tuner
+    from uptune_tpu.space.params import EnumParam, Pow2Param, ScheduleParam
+    from uptune_tpu.space.spec import Space
+
+    stages = ("in", "blur_x", "blur_y", "sharpen", "out")
+    deps = (("blur_x", ("in",)),
+            ("blur_y", ("blur_x",)),
+            ("sharpen", ("blur_y",)),
+            ("out", ("sharpen",)))
+    space = Space([
+        ScheduleParam("order", items=stages, deps=deps),
+        Pow2Param("tile_x", 8, 256),
+        Pow2Param("tile_y", 8, 256),
+        Pow2Param("vec", 4, 32),
+        EnumParam("store_at", ("root", "inline", "tile")),
+    ])
+
+    def objective(cfgs):
+        out = []
+        for c in cfgs:
+            order = c["order"]
+            pos = {s: i for i, s in enumerate(order)}
+            # producer-consumer distance = lost locality
+            locality = sum(abs(pos[a] - pos[b]) - 1
+                           for a, bs in deps for b in bs)
+            tile_cost = (abs(pos_log(c["tile_x"]) - 6)      # 64 ideal
+                         + abs(pos_log(c["tile_y"]) - 5)    # 32 ideal
+                         + abs(pos_log(c["vec"]) - 3))      # 8 ideal
+            store = {"root": 1.0, "inline": 0.5, "tile": 0.0}[c["store_at"]]
+            out.append(locality * 2.0 + tile_cost + store)
+        return out
+
+    def pos_log(v):
+        return v.bit_length() - 1
+
+    t = Tuner(space, objective, seed=0)
+    res = t.run(test_limit=400)
+    t.close()
+    print("best schedule:", res.best_config["order"])
+    print("tiles:", res.best_config["tile_x"], res.best_config["tile_y"],
+          "vec:", res.best_config["vec"],
+          "store:", res.best_config["store_at"],
+          f"cost={res.best_qor:.2f}")
+    # the dependency contract holds for every decoded schedule
+    order = res.best_config["order"]
+    pos = {s: i for i, s in enumerate(order)}
+    assert all(pos[b] < pos[a] for a, bs in deps for b in bs)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

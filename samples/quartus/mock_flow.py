@@ -1,0 +1,58 @@
+"""Deterministic mock Quartus flow: consumes the tuned options from the
+environment (written by synthesis.py) and emits STA/syn/fit report
+files in the real Quartus text formats, so the whole report-extraction
+path (uptune_tpu.api.features) is exercised without licensed tools.
+
+The QoR model: slack improves with higher effort/seed luck and
+aggressive physical synthesis, resources grow with effort — shaped like
+the tradeoffs the reference tunes (samples/quartus/synthesis.py:1-302).
+"""
+import json
+import os
+import sys
+
+
+def run(design: str, workdir: str, opts: dict) -> None:
+    seed = int(opts.get("seed", 1))
+    effort = {"fast": 0.0, "auto": 0.5, "high": 1.0}[
+        opts.get("fitter_effort", "auto")]
+    physopt = 1.0 if opts.get("physical_synthesis", False) else 0.0
+    mux = {"off": 0.0, "on": 0.3, "auto": 0.15}[
+        opts.get("mux_restructure", "auto")]
+    lut = int(opts.get("max_lut_depth", 6))
+
+    # deterministic "luck" per seed
+    luck = ((seed * 2654435761) % 997) / 997.0
+    slack = (-1.5 + 1.2 * effort + 0.6 * physopt + 0.4 * mux
+             + 0.35 * luck - 0.08 * abs(lut - 5))
+    tns = min(0.0, slack) * 120.0
+    alms = int(10000 * (1.0 + 0.25 * effort + 0.15 * physopt))
+    regs = int(8000 * (1.0 + 0.1 * effort))
+    ffs = int(regs * 1.1)
+
+    with open(os.path.join(workdir, f"{design}.sta.syn.summary"),
+              "w") as f:
+        f.write("Type  : setup\n")
+        f.write(f"Slack : {slack:.3f}\n")
+        f.write(f"TNS : {tns:.1f}\n")
+    with open(os.path.join(workdir, f"{design}.syn.rpt"), "w") as f:
+        f.write(f"; boundary_port ; {240} ;\n")
+        f.write(f"; fourteennm_ff ; {ffs:,} ;\n")
+        f.write(f"; fourteennm_lcell_comb ; {alms:,} ;\n")
+        f.write(f"; Max LUT depth ; {lut}.00 ;\n")
+        f.write(f"; Average LUT depth ; {lut * 0.6:.2f} ;\n")
+    with open(os.path.join(workdir, f"{design}.fit.syn.summary"),
+              "w") as f:
+        f.write(f"Logic utilization (in ALMs) : {alms:,} / 100,000\n")
+        f.write(f"Total dedicated logic registers : {regs:,}\n")
+        f.write("Total pins : 120 / 500\n")
+        f.write(f"Total block memory bits : {alms * 12:,}\n")
+        f.write("Total RAM Blocks : 24 / 99\n")
+        f.write("Total DSP Blocks : 12 / 48\n")
+
+
+if __name__ == "__main__":
+    design = sys.argv[1]
+    workdir = sys.argv[2]
+    opts = json.loads(sys.argv[3])
+    run(design, workdir, opts)

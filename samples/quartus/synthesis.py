@@ -1,0 +1,43 @@
+"""Quartus synthesis/fit option tuning — the shape of the reference's
+quartus sample (/root/reference/samples/quartus/synthesis.py:1-302:
+~13 tuned synth+fit options, feature extraction from STA/syn/fit
+reports feeding `ut.feature` covariates, QoR = timing slack).
+
+Runs against `mock_flow.py` (a deterministic stand-in emitting
+real-format report files) so the full option->flow->report->extract->
+covariate->QoR loop works without licensed tools; point `FLOW` at a
+real quartus_sh wrapper to tune actual hardware builds.
+
+    ut samples/quartus/synthesis.py -pf 2 --test-limit 40
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import uptune_tpu as ut
+
+DESIGN = "mm8x8"
+HERE = os.path.dirname(os.path.abspath(__file__))
+FLOW = [sys.executable, os.path.join(HERE, "mock_flow.py")]
+
+opts = {
+    "seed": ut.tune(1, (1, 64), name="seed"),
+    "fitter_effort": ut.tune("auto", ["fast", "auto", "high"],
+                             name="fitter_effort"),
+    "physical_synthesis": ut.tune(False, name="physical_synthesis"),
+    "mux_restructure": ut.tune("auto", ["off", "on", "auto"],
+                               name="mux_restructure"),
+    "max_lut_depth": ut.tune(6, (3, 9), name="max_lut_depth"),
+}
+
+workdir = tempfile.mkdtemp(prefix="quartus_")
+subprocess.run(FLOW + [DESIGN, workdir, json.dumps(opts)], check=True,
+               timeout=600)
+
+# extract report features -> covariates (report.py:163-174 semantics)
+vec = ut.quartus(DESIGN, workdir)
+print(f"slack={vec['slack']:.3f} alms={vec.get('Logic utilization (in ALMs)')}")
+
+ut.target(vec["slack"], "max")

@@ -133,8 +133,21 @@ class TestProgramTuner:
         assert all("tech" in r for r in rows)
         assert len({r["tech"] for r in rows}) >= 1
 
+    def test_budget_not_overrun_by_wide_tickets(self, tmp_path):
+        """--test-limit N must launch ~N trials even while a whole
+        technique batch (e.g. a 30-member DE population) is in flight:
+        round-2 regression — the evals-based gate only advanced when a
+        full ticket resolved, so limit=25 ran 50+ subprocesses."""
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=10, seed=2)
+        res = pt.run()
+        assert res.evals <= 10 + pt.parallel, res.evals
+        assert pt.pool.launched <= 10 + pt.parallel
+
     def test_timeout_kill_and_worker_replacement(self, tmp_path):
-        pt = _mk_tuner(tmp_path, SLOW_PROG, test_limit=8, seed=3,
+        # 24 trials over a space where ~half hang: the budget is now
+        # enforced per-trial (told-gated), so the limit must be wide
+        # enough that some x < 50 trial is actually launched
+        pt = _mk_tuner(tmp_path, SLOW_PROG, test_limit=24, seed=3,
                        runtime_limit=1.0)
         t0 = time.time()
         res = pt.run()

@@ -145,6 +145,10 @@ class Tuner:
         self.best = Best.empty(space)
         self.archive_path = archive
         self.evals = 0
+        # trials individually resolved via tell(); unlike `evals` (which
+        # advances only when a whole ticket finalizes) this never lags,
+        # so budget gates stay accurate while a wide batch is in flight
+        self.told = 0
         self.steps = 0
         self.gid = 0
         self.trace: List[float] = []
@@ -312,6 +316,7 @@ class Tuner:
             novel)
         self.gid = max(int(r["gid"]) for r in rows) + 1
         self.evals = len(rows)
+        self.told = len(rows)
         running = float("inf")
         for q in qor:
             running = min(running, float(q))
@@ -491,6 +496,7 @@ class Tuner:
         # turn a failure into an unbeatable -inf best)
         trial.qor = self.sign * v if math.isfinite(v) else float("inf")
         trial.dur = dur
+        self.told += 1
         tk = trial.ticket
         tk.remaining -= 1
         if tk.remaining == 0:

@@ -256,8 +256,11 @@ class ProgramTuner:
                         pre_launch=pre_launch) as pool:
             self.pool = pool
             while True:
+                # gate on told (per-trial), not evals (per-ticket): a
+                # wide in-flight ticket must still count against the
+                # budget, or a --test-limit 25 run launches 50+ trials
                 outstanding = pool.busy_count + len(queue)
-                if (tuner.evals + outstanding < limit
+                if (tuner.told + outstanding < limit
                         and len(queue) < len(pool.free_slots())
                         and dry_asks < 8):
                     want = len(pool.free_slots()) - len(queue)
@@ -265,10 +268,10 @@ class ProgramTuner:
                     queue.extend(asked)
                     dry_asks = 0 if asked else dry_asks + 1
                 while queue and pool.free_slots() and \
-                        tuner.evals + pool.busy_count < limit:
+                        tuner.told + pool.busy_count < limit:
                     pool.submit(queue.popleft(), stage=self.stage)
                 if pool.busy_count == 0:
-                    if tuner.evals >= limit:
+                    if tuner.told >= limit:
                         break
                     if not queue and dry_asks >= 8:
                         break  # space saturated: nothing left to propose

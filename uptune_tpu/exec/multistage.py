@@ -150,7 +150,7 @@ class DecoupledTuner:
                 progress = False
                 for st in stages:
                     tuner, pool = st.tuner, st.pool
-                    if (tuner.evals + pool.busy_count + len(st.queue)
+                    if (tuner.told + pool.busy_count + len(st.queue)
                             < limit and
                             len(st.queue) < len(pool.free_slots())
                             and st.dry_asks < 8):
@@ -159,7 +159,7 @@ class DecoupledTuner:
                         st.queue.extend(asked)
                         st.dry_asks = 0 if asked else st.dry_asks + 1
                     while st.queue and pool.free_slots() and \
-                            tuner.evals + pool.busy_count < limit:
+                            tuner.told + pool.busy_count < limit:
                         pool.submit(st.queue.pop(0), stage=st.index)
                         progress = True
                     for trial, qor, dur, info in pool.poll(pt.interval):
@@ -168,7 +168,7 @@ class DecoupledTuner:
                         if stats is not None and stats.was_new_best:
                             self._publish_stage_best(st)
                 done = all(
-                    st.tuner.evals >= limit or (
+                    st.tuner.told >= limit or (
                         st.pool.busy_count == 0 and not st.queue
                         and st.dry_asks >= 8)
                     for st in stages) and all(
@@ -340,9 +340,16 @@ class MultiStageTuner:
         epoch = 0
         feat_of: Dict[int, Any] = {}         # gid -> feature vector
         try:
-            while tuner.evals < limit:
+            while tuner.told < limit:
                 epoch += 1
-                trials = tuner.ask(min_trials=n_pre)[:n_pre]
+                asked = tuner.ask(min_trials=n_pre)
+                # cancel the tail of the last ticket instead of slicing
+                # it off: an orphaned (never told/cancelled) trial keeps
+                # its whole ticket open forever — evals stalls and its
+                # pending hashes are never released
+                trials = asked[:n_pre]
+                for tr in asked[n_pre:]:
+                    tuner.cancel(tr)
                 if not trials:
                     break
                 # ---- 'pre' phase: run to the interm breakpoint
