@@ -1,0 +1,33 @@
+"""ABC logic-synthesis recipe tuning — the shape of the reference
+sample (/root/reference/samples/abc-options/abc.py:1-25: a sequence of
+optimization passes plus `resub -K`), over a deterministic synthetic
+recipe model since no ABC binary ships in this image.
+
+The space: an ordering of 8 optimization passes (PermParam) plus the
+resub cut size K and two enum knobs.  The synthetic cost rewards known
+good pass adjacencies (e.g. `balance` early, `rewrite` before `refactor`)
+— structurally the pass-interaction landscape real recipes exhibit.
+
+    ut samples/abc-options/abc.py -pf 2 --test-limit 80
+"""
+import uptune_tpu as ut
+
+PASSES = ("balance", "rewrite", "rewrite -z", "refactor",
+          "refactor -z", "resub", "dc2", "dch")
+
+order = ut.tune(list(PASSES), list(PASSES), name="recipe")
+k = ut.tune(8, (4, 16), name="resub_k")
+lutsize = ut.tune(6, [4, 6], name="lut_size")
+effort = ut.tune("fast", ["fast", "deep"], name="effort")
+
+pos = {p: i for i, p in enumerate(order)}
+cost = 100.0
+cost -= 8.0 * (len(PASSES) - 1 - pos["balance"])       # balance early
+cost -= 4.0 * max(0, pos["refactor"] - pos["rewrite"])  # rewrite first
+cost -= 3.0 * max(0, pos["resub"] - pos["dc2"])         # resub after dc2
+cost += 0.5 * abs(k - 10)                               # sweet spot K=10
+cost += 2.0 if lutsize == 4 else 0.0
+cost -= 1.5 if effort == "deep" else 0.0
+
+ut.target(cost, "min")
+print("recipe:", "; ".join(order), f"K={k} cost={cost:.1f}")
