@@ -1,0 +1,102 @@
+"""Search-hook observer tests (the reference's SearchPlugin +
+display plugins, search/plugin.py:26-153)."""
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from uptune_tpu.driver.driver import Tuner  # noqa: E402
+from uptune_tpu.driver.plugins import (FileDisplay, LogDisplay,  # noqa: E402
+                                       SearchHook)
+from uptune_tpu.space.params import FloatParam  # noqa: E402
+from uptune_tpu.space.spec import Space  # noqa: E402
+
+
+def _space():
+    return Space([FloatParam("x", -2.0, 2.0), FloatParam("y", -2.0, 2.0)])
+
+
+def _obj(cfgs):
+    return [c["x"] ** 2 + c["y"] ** 2 for c in cfgs]
+
+
+class Recorder(SearchHook):
+    def __init__(self):
+        self.events = []
+
+    def on_start(self, tuner):
+        self.events.append(("start",))
+
+    def on_result(self, tuner, trial, qor):
+        self.events.append(("result", trial.gid, qor))
+
+    def on_step(self, tuner, stats):
+        self.events.append(("step", stats.technique))
+
+    def on_new_best(self, tuner, config, qor):
+        self.events.append(("best", qor))
+
+    def on_finish(self, tuner, result):
+        self.events.append(("finish", result.evals))
+
+
+class TestHooks:
+    def test_lifecycle_and_counts(self):
+        rec = Recorder()
+        t = Tuner(_space(), _obj, seed=0, hooks=[rec])
+        res = t.run(test_limit=100)
+        t.close()
+        kinds = [e[0] for e in rec.events]
+        assert kinds[0] == "start" and kinds[-1] == "finish"
+        assert kinds.count("result") == res.evals
+        assert kinds.count("step") == res.steps
+        assert "best" in kinds
+        # best events are monotone improving
+        bests = [e[1] for e in rec.events if e[0] == "best"]
+        assert bests == sorted(bests, reverse=True)
+        assert rec.events[-1] == ("finish", res.evals)
+
+    def test_failing_hook_does_not_kill_run(self):
+        class Bomb(SearchHook):
+            def on_step(self, tuner, stats):
+                raise RuntimeError("boom")
+
+        t = Tuner(_space(), _obj, seed=0, hooks=[Bomb()])
+        res = t.run(test_limit=60)
+        t.close()
+        assert res.evals >= 60
+
+    def test_failure_qor_reported_as_none(self):
+        rec = Recorder()
+
+        def obj(cfgs):
+            return [float("nan") for _ in cfgs]
+
+        t = Tuner(_space(), obj, seed=0, hooks=[rec])
+        t.step()
+        t.close()
+        results = [e for e in rec.events if e[0] == "result"]
+        assert results and all(e[2] is None for e in results)
+
+
+class TestDisplays:
+    def test_log_display(self, capsys):
+        import sys
+        t = Tuner(_space(), _obj, seed=0,
+                  hooks=[LogDisplay(interval=0.0, out=sys.stdout)])
+        t.run(test_limit=80)
+        t.close()
+        out = capsys.readouterr().out
+        assert "NEW BEST" in out and "evals=" in out
+
+    def test_file_display(self, tmp_path):
+        p = tmp_path / "best.log"
+        t = Tuner(_space(), _obj, seed=0, hooks=[FileDisplay(str(p))])
+        res = t.run(test_limit=80)
+        t.close()
+        rows = [json.loads(l) for l in p.read_text().splitlines()]
+        assert rows
+        assert rows[-1]["qor"] == pytest.approx(res.best_qor)
+        qs = [r["qor"] for r in rows]
+        assert qs == sorted(qs, reverse=True)

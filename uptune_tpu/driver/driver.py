@@ -33,6 +33,7 @@ from ..techniques import base as tbase
 from ..techniques.base import Best, Technique
 from ..techniques.bandit import MetaTechnique
 from .history import History, dup_source
+from .plugins import fire as _fire
 
 Objective = Callable[[List[Dict[str, Any]]], Sequence[float]]
 
@@ -129,7 +130,8 @@ class Tuner:
                  resume: bool = False,
                  surrogate=None, surrogate_opts: Optional[dict] = None,
                  config_filter: Optional[
-                     Callable[[Dict[str, Any]], bool]] = None):
+                     Callable[[Dict[str, Any]], bool]] = None,
+                 hooks: Optional[Sequence] = None):
         assert sense in ("min", "max"), sense
         self.space = space
         self.objective = objective
@@ -160,6 +162,8 @@ class Tuner:
         self._pending: set = set()
         # per-technique attribution counters (pulls, evals, new-bests)
         self.arm_stats: Dict[str, List[int]] = {}
+        # observer hooks (search/plugin.py:26-62 equivalents)
+        self.hooks = list(hooks or [])
 
         # surrogate-ensemble pruning (api.py:291-326 semantics)
         if isinstance(surrogate, str):
@@ -224,6 +228,7 @@ class Tuner:
             # not resuming, but never append to a different space's file:
             # check (or backfill) the signature header before reuse
             self._check_archive_header(archive)
+        _fire(self.hooks, "on_start", self)
         self._archive_f = open(archive, "a") if archive else None
         if self._archive_f is not None and self._archive_f.tell() == 0:
             # header: full space signature, checked on every reopen
@@ -497,6 +502,10 @@ class Tuner:
         trial.qor = self.sign * v if math.isfinite(v) else float("inf")
         trial.dur = dur
         self.told += 1
+        if self.hooks:
+            _fire(self.hooks, "on_result", self, trial,
+                  qor if qor is not None and math.isfinite(float(qor))
+                  else None)
         tk = trial.ticket
         tk.remaining -= 1
         if tk.remaining == 0:
@@ -572,8 +581,16 @@ class Tuner:
                 f"Tuner(capacity=...)")
         self.steps += 1
         self._flush_archive()
-        return StepStats(self.steps, tk.arm_name, tk.cands.batch, evaluated,
-                         self.sign * new, was_new_best, tk.pruned)
+        stats = StepStats(self.steps, tk.arm_name, tk.cands.batch,
+                          evaluated, self.sign * new, was_new_best,
+                          tk.pruned)
+        if self.hooks:
+            if was_new_best:
+                res = self.result()
+                _fire(self.hooks, "on_new_best", self,
+                      res.best_config, res.best_qor)
+            _fire(self.hooks, "on_step", self, stats)
+        return stats
 
     def step(self) -> StepStats:
         """One synchronous acquisition step: acquire -> evaluate novel
@@ -634,6 +651,9 @@ class Tuner:
         return self.result().best_config
 
     def close(self):
+        if self.hooks:
+            _fire(self.hooks, "on_finish", self, self.result())
+            self.hooks = []
         if self._archive_f is not None:
             self._archive_f.close()
             self._archive_f = None

@@ -60,11 +60,12 @@ class ProgramTuner:
                  surrogate=None, env: Optional[Dict[str, str]] = None,
                  sandbox: bool = True,
                  status_interval: Optional[int] = None,
-                 template=None):
+                 template=None, hooks=None):
         # template: a TemplateProgram (non-intrusive mode) — the space
         # comes from its annotations and each trial renders its own copy
         # of the source into the sandbox before launch
         self.template = template
+        self.hooks = hooks
         if template is not None and isinstance(command, (list, tuple)):
             # trials must execute the per-sandbox RENDERED copy, so any
             # absolute reference to the annotated source becomes relative
@@ -175,7 +176,8 @@ class ProgramTuner:
         return Tuner(space, None, technique=self.technique,
                      seed=self.seed, sense=self.sense,
                      archive=self.archive, resume=self.resume,
-                     surrogate=self.surrogate, config_filter=filt)
+                     surrogate=self.surrogate, config_filter=filt,
+                     hooks=self.hooks)
 
     def _maybe_new_best(self, stats) -> None:
         if stats is not None and stats.was_new_best:
