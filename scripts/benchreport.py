@@ -183,15 +183,48 @@ def one_run(problem: str, mode: str, seed: int, budget: int):
             "censored": it >= budget and res.best_qor > thresh}
 
 
-def run_suite(problems, seeds: int, budget_scale: float = 1.0):
+def _load_state(path):
+    done = {}
+    if path and os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                done[(r["problem"], r["mode"], r["seed"])] = r
+    return done
+
+
+def run_suite(problems, seeds: int, budget_scale: float = 1.0,
+              state_path: str = None):
+    """Per-run results checkpoint to `state_path` (jsonl) so a crashed
+    sweep resumes instead of redoing hours of runs."""
+    done = _load_state(state_path)
+    state_f = open(state_path, "a") if state_path else None
     rows = []
     for prob in problems:
         budget = int(PROBLEMS[prob]()[3] * budget_scale)
         for mode in ("baseline", "tpu"):
             per_seed = []
             for s in range(seeds):
+                key = (prob, mode, 1000 + s)
+                cached = done.get(key)
+                # a cached row is only valid for the SAME budget — a
+                # --quick state file must not leak half-budget iters
+                # into a full run's table
+                if cached is not None and \
+                        cached.get("budget", budget) == budget:
+                    per_seed.append(cached)
+                    continue
                 r = one_run(prob, mode, seed=1000 + s, budget=budget)
+                r["budget"] = budget
                 per_seed.append(r)
+                if state_f is not None:
+                    state_f.write(json.dumps(
+                        {"problem": prob, "mode": mode,
+                         "seed": 1000 + s, **r}) + "\n")
+                    state_f.flush()
                 print(f"  {prob} {mode} seed={s} iters={r['iters']}"
                       f"{' (censored)' if r['censored'] else ''} "
                       f"best={r['best']:.4g} [{r['wall_s']}s]",
@@ -251,12 +284,15 @@ if __name__ == "__main__":
                     help="3 seeds, smaller budgets, rosenbrock-2d only")
     ap.add_argument("--problems", nargs="*", default=None)
     ap.add_argument("--out", default=None, help="write markdown here")
+    ap.add_argument("--state", default=None,
+                    help="per-run checkpoint jsonl (resume after crash)")
     args = ap.parse_args()
     problems = args.problems or (
         ["rosenbrock-2d"] if args.quick else list(PROBLEMS))
     seeds = 3 if args.quick else args.seeds
     rows = run_suite(problems, seeds,
-                     budget_scale=0.5 if args.quick else 1.0)
+                     budget_scale=0.5 if args.quick else 1.0,
+                     state_path=args.state)
     if args.out:
         with open(args.out, "w") as f:
             f.write(to_markdown(rows, seeds))

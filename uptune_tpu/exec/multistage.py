@@ -134,7 +134,8 @@ class DecoupledTuner:
                     sense=pt.sense,
                     archive=os.path.join(self.work_dir,
                                          f"ut.archive_stage{s}.jsonl"),
-                    resume=pt.resume, hooks=pt.hooks)
+                    resume=pt.resume, hooks=pt.hooks,
+                    label=f"stage{s}")
                 pool = WorkerPool(
                     pt.command, self.work_dir, pt.parallel,
                     runtime_limit=pt.runtime_limit, env=pt.env_extra,
