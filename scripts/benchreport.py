@@ -220,6 +220,12 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
                 r = one_run(prob, mode, seed=1000 + s, budget=budget)
                 r["budget"] = budget
                 per_seed.append(r)
+                # every run builds a fresh Tuner => fresh jitted
+                # programs; without this the executable cache grows
+                # unboundedly across the sweep until LLVM OOMs
+                # (observed twice at ~100 runs in)
+                import jax
+                jax.clear_caches()
                 if state_f is not None:
                     state_f.write(json.dumps(
                         {"problem": prob, "mode": mode,

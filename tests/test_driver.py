@@ -102,6 +102,7 @@ class TestTuner:
         assert res.best_qor > -0.05
         assert abs(res.best_config["x"] - 7.0) < 0.3
 
+    @pytest.mark.slow
     def test_tsp_converges(self):
         n = 8
         dist = random_tsp_distances(n, seed=4)
@@ -163,6 +164,7 @@ class TestTuner:
 
 
 class TestArchiveResume:
+    @pytest.mark.slow
     def test_archive_written_and_resumed(self, tmp_path):
         space = rosenbrock_space(2, -3.0, 3.0)
         arc = str(tmp_path / "archive.jsonl")
@@ -241,6 +243,7 @@ class TestArchiveResume:
             t2 = Tuner(s2, obj, archive=arc, resume=True)
         assert t2.evals == 0
 
+    @pytest.mark.slow
     def test_resume_survives_torn_tail(self, tmp_path):
         arc = str(tmp_path / "archive.jsonl")
         space = rosenbrock_space(2, -3.0, 3.0)

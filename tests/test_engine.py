@@ -3,6 +3,7 @@ devices via conftest)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from uptune_tpu.engine import FusedEngine, default_arms
 from uptune_tpu.parallel import ShardedEngine, make_mesh
@@ -70,6 +71,7 @@ class TestFusedEngine:
         assert np.isfinite(eng.best_qor(state))
 
 
+@pytest.mark.slow
 class TestShardedEngine:
     def test_mesh_8_devices(self):
         assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"

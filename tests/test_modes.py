@@ -70,6 +70,7 @@ class TestTemplate:
         p.write_text("print('no annotations')\n")
         assert detect_template(str(p)) is None
 
+    @pytest.mark.slow
     def test_template_end_to_end(self, tmp_path):
         p = tmp_path / "prog.py"
         p.write_text(self.TPL)
@@ -84,6 +85,7 @@ class TestTemplate:
 
 # ---------------------------------------------------------------------
 class TestDecouple:
+    @pytest.mark.slow
     def test_mode_detection_and_run(self, tmp_path):
         shutil.copy(os.path.join(SAMPLES, "decomposed", "decomposed.py"),
                     tmp_path / "decomposed.py")
@@ -120,6 +122,7 @@ MULTI_PROG = textwrap.dedent("""\
 
 
 class TestMultiStage:
+    @pytest.mark.slow
     def test_pre_post_epochs(self, tmp_path):
         p = tmp_path / "prog.py"
         p.write_text(MULTI_PROG)

@@ -115,6 +115,7 @@ class TestManager:
         assert keep.all()  # explore_frac=1.0 keeps everything
 
 
+@pytest.mark.slow
 class TestTunerIntegration:
     @pytest.mark.parametrize("kind", ["gp", "mlp"])
     def test_tuner_with_surrogate_converges(self, kind):
