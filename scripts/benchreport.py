@@ -13,9 +13,12 @@ implementation of the reference's algorithms:
   the reference's unit (one config per desired_result() call,
   opentuner/search/driver.py:160-207).
 * tpu mode — the same portfolio plus the TPU-native additions: GP
-  surrogate with marginal-likelihood hyperparameter fitting and top-k
+  surrogate with marginal-likelihood hyperparameter fitting, EI top-k
   batch concentration (only the predicted-best half of each proposed
-  batch is evaluated).
+  batch is evaluated), and the surrogate PROPOSAL plane — every other
+  acquisition the manager emits its own EI-maximizing batch from an
+  oversampled pool (uniform + multi-scale incumbent perturbations),
+  scored on device where ranking thousands of candidates is free.
 
 Metric per run: number of EVALUATIONS until best-so-far reaches the
 space's optimum threshold (censored at the eval budget).  Reported:
@@ -157,20 +160,32 @@ def iters_to_threshold(trace, thresh: float, budget: int) -> int:
     return budget  # censored
 
 
-def one_run(problem: str, mode: str, seed: int, budget: int):
+TPU_SOPTS = {
+    # top-k batch concentration + the surrogate proposal plane
+    # (EI-maximizing batches from an oversampled pool, every other
+    # acquisition once fitted).  Settings selected by the calibration
+    # grid (scripts/calibrate_tpu.py): keep_frac 0.25 over-exploits and
+    # censors on rosenbrock-4d; 0.5 wins on every space tested; the
+    # proposal plane is where the big iters-to-optimum cut comes from.
+    "min_points": 16, "refit_interval": 16, "max_points": 256,
+    "select": "topk", "keep_frac": 0.5, "explore_frac": 0.1,
+    "score": "ei", "propose_batch": 8, "propose_every": 2,
+    "pool_mult": 64,
+}
+
+
+def one_run(problem: str, mode: str, seed: int, budget: int,
+            sopts_override: dict = None):
     from uptune_tpu.driver.driver import Tuner
 
     space, objective, thresh, _ = PROBLEMS[problem]()
     surrogate = None
     sopts = None
     if mode == "tpu":
-        # top-k batch concentration, settings selected by the
-        # calibration grid (keep_frac 0.25 over-exploits and censors on
-        # rosenbrock-4d; 0.5 wins on every space tested)
         surrogate = "gp"
-        sopts = {"min_points": 32, "refit_interval": 32,
-                 "max_points": 256, "select": "topk",
-                 "keep_frac": 0.5, "explore_frac": 0.1}
+        sopts = dict(TPU_SOPTS)
+        if sopts_override:
+            sopts.update(sopts_override)
     tuner = Tuner(space, objective, seed=seed, surrogate=surrogate,
                   surrogate_opts=sopts)
     t0 = time.time()
@@ -181,6 +196,13 @@ def one_run(problem: str, mode: str, seed: int, budget: int):
     return {"iters": it, "best": res.best_qor, "evals": res.evals,
             "wall_s": round(wall, 1),
             "censored": it >= budget and res.best_qor > thresh}
+
+
+def _sopts_sig(mode: str):
+    """Fingerprint of the settings a cached row was measured under."""
+    if mode != "tpu":
+        return "baseline"
+    return json.dumps(TPU_SOPTS, sort_keys=True)
 
 
 def _load_state(path):
@@ -210,15 +232,21 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
             for s in range(seeds):
                 key = (prob, mode, 1000 + s)
                 cached = done.get(key)
-                # a cached row is only valid for the SAME budget — a
-                # --quick state file must not leak half-budget iters
-                # into a full run's table
+                # a cached row is only valid for the SAME budget AND the
+                # same tpu-mode surrogate settings — a --quick state file
+                # must not leak half-budget iters into a full run's
+                # table, and rows measured under older TPU_SOPTS must
+                # not be reported as the current mode's numbers (legacy
+                # rows without the fields are always re-run)
+                sig = _sopts_sig(mode)
                 if cached is not None and \
-                        cached.get("budget", budget) == budget:
+                        cached.get("budget") == budget and \
+                        cached.get("sopts_sig") == sig:
                     per_seed.append(cached)
                     continue
                 r = one_run(prob, mode, seed=1000 + s, budget=budget)
                 r["budget"] = budget
+                r["sopts_sig"] = sig
                 per_seed.append(r)
                 # every run builds a fresh Tuner => fresh jitted
                 # programs; without this the executable cache grows
@@ -256,8 +284,10 @@ def to_markdown(rows, seeds):
         "optimum threshold (rosenbrock-2d: QoR <= 0.1; -4d: <= 1.0;",
         "gcc-options-shaped: 90% of the greedy-achievable improvement).",
         "`baseline` is the reference's search stack run faithfully",
-        "(AUC-bandit portfolio, no surrogate); `tpu` adds GP top-k",
-        "batch concentration.",
+        "(AUC-bandit portfolio, no surrogate); `tpu` adds the GP",
+        "surrogate plane: EI top-k batch concentration plus",
+        "EI-maximizing proposal batches from an oversampled pool",
+        "(surrogate/manager.py propose_pool) every other acquisition.",
         f"{seeds} seeds per cell.  Regenerate:",
         "`python scripts/benchreport.py --seeds 30 --out BENCHREPORT.md`.",
         "",

@@ -1,0 +1,87 @@
+"""Calibration grid for the tpu-mode surrogate settings in benchreport.
+
+Runs a handful of seeds per (problem, variant) and prints median
+iters-to-threshold, so TPU_SOPTS choices are evidence-backed rather than
+guessed.  Variants are small dict overrides on top of TPU_SOPTS.
+
+Usage: python scripts/calibrate_tpu.py [--seeds 6] [--problems ...]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import cpuenv  # noqa: F401  (hang-proof platform)
+
+import numpy as np
+
+from benchreport import PROBLEMS, TPU_SOPTS, one_run
+
+VARIANTS = {
+    "old": {"propose_batch": 0, "min_points": 32, "refit_interval": 32,
+            "score": "lcb"},
+    "new": {},
+    "pb16": {"propose_batch": 16},
+    "every3": {"propose_every": 3},
+    "lcb-pool": {"score": "lcb"},
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=6)
+    ap.add_argument("--problems", nargs="*",
+                    default=["rosenbrock-2d", "rosenbrock-4d",
+                             "gcc-options"])
+    ap.add_argument("--variants", nargs="*", default=list(VARIANTS))
+    ap.add_argument("--state", default="calib_state.jsonl")
+    args = ap.parse_args()
+
+    done = {}
+    if os.path.exists(args.state):
+        with open(args.state) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                    done[(r["problem"], r["variant"], r["seed"])] = r
+                except json.JSONDecodeError:
+                    pass
+    sf = open(args.state, "a")
+    for prob in args.problems:
+        budget = PROBLEMS[prob]()[3]
+        for var in args.variants:
+            # cached rows are only valid for the SAME effective settings
+            # and budget (same staleness class benchreport._sopts_sig
+            # guards against)
+            sig = json.dumps({**TPU_SOPTS, **VARIANTS[var],
+                              "budget": budget}, sort_keys=True)
+            iters = []
+            for s in range(args.seeds):
+                key = (prob, var, 1000 + s)
+                if key in done and done[key].get("sig") == sig:
+                    iters.append(done[key]["iters"])
+                    continue
+                t0 = time.time()
+                r = one_run(prob, "tpu", seed=1000 + s, budget=budget,
+                            sopts_override=VARIANTS[var])
+                import jax
+                jax.clear_caches()
+                iters.append(r["iters"])
+                sf.write(json.dumps({"problem": prob, "variant": var,
+                                     "seed": 1000 + s, "sig": sig,
+                                     **r}) + "\n")
+                sf.flush()
+                print(f"  {prob} {var} seed={s} iters={r['iters']}"
+                      f"{' (censored)' if r['censored'] else ''} "
+                      f"[{time.time() - t0:.0f}s]", file=sys.stderr)
+            print(json.dumps({
+                "problem": prob, "variant": var,
+                "median": float(np.median(iters)),
+                "iqr": [float(np.percentile(iters, 25)),
+                        float(np.percentile(iters, 75))]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -161,6 +161,7 @@ class Tuner:
         self._zero_novel_streak = 0
         self._cap_warned = False
         self.pruned_total = 0
+        self._surr_tick = 0   # acquisition counter for propose_every
         # hashes proposed but not yet resolved (the reference's _pending
         # list, api.py:254-280): asked trials must not be re-proposed
         self._pending: set = set()
@@ -365,9 +366,51 @@ class Tuner:
             novel_np = novel_np & ~np.isin(packed, pend)
         return novel_np, int(novel_np.sum())
 
+    def _acquire_surrogate(self) -> Optional[_Ticket]:
+        """Surrogate proposal plane: every `propose_every`-th acquisition
+        (once fitted) the manager emits its own EI-maximizing batch from
+        an oversampled pool (surrogate/manager.py propose_pool) instead of
+        only filtering an arm's batch.  The ticket carries no technique
+        state and earns no bandit credit (like injected seeds), but IS
+        attributed in the archive as 'surrogate'."""
+        sm = self.surrogate
+        if (sm is None or not getattr(sm, "propose_batch", 0)
+                or not sm.fitted
+                or not math.isfinite(float(self.best.qor))):
+            return None
+        self._surr_tick += 1
+        if self._surr_tick % max(1, sm.propose_every):
+            return None
+        self.key, k = jax.random.split(self.key)
+        cands = sm.propose_pool(k, self.best.u, self.best.perms,
+                                float(self.best.qor))
+        if cands is None:
+            return None
+        tk = self._open_injected_ticket(cands, "surrogate")
+        if not tk.trials:
+            return None  # pool saturated around the incumbent: use arms
+        return tk
+
+    def _open_injected_ticket(self, cands: CandBatch,
+                              source: str) -> _Ticket:
+        """Dedup -> pending-mask -> injected ticket -> open: the shared
+        plumbing behind inject() and the surrogate proposal plane.
+        Injected tickets never touch technique states or bandit credit."""
+        hashes, found, known, src, novel = self._dedup(
+            self.hist_state, cands)
+        novel_np, _ = self._mask_pending(hashes, novel)
+        tk = _Ticket(None, source, None, cands, hashes,
+                     np.asarray(known, np.float32).copy(),
+                     np.asarray(src), novel_np, injected=True, pruned=0)
+        self._open_ticket(tk)
+        return tk
+
     def _acquire(self) -> _Ticket:
         """Choose arm -> propose batch -> dedup (history + in-batch +
         pending) -> surrogate prune; returns the open ticket."""
+        tk = self._acquire_surrogate()
+        if tk is not None:
+            return tk
         order = (self.root.select_order()
                  if isinstance(self.root, MetaTechnique) else [self.root])
         order = [t for t in order if t.name in self._tstates]
@@ -463,13 +506,7 @@ class Tuner:
         technique states or bandit credit; resolve the returned trials
         via tell()."""
         cands = self.space.from_configs(list(cfgs))
-        hashes, found, known, src, novel = self._dedup(
-            self.hist_state, cands)
-        novel_np, _ = self._mask_pending(hashes, novel)
-        tk = _Ticket(None, source, None, cands, hashes,
-                     np.asarray(known, np.float32).copy(),
-                     np.asarray(src), novel_np, injected=True, pruned=0)
-        self._open_ticket(tk)
+        tk = self._open_injected_ticket(cands, source)
         if not tk.trials:
             self._finalize(tk)  # all dups: serve + commit immediately
             return []

@@ -173,14 +173,23 @@ def predict(state: GPState, xq: jax.Array) -> Tuple[jax.Array, jax.Array]:
             jnp.sqrt(var) * state.y_std)
 
 
-def expected_improvement(state: GPState, xq: jax.Array,
-                         best: jax.Array) -> jax.Array:
-    """EI for minimization: E[max(best - f, 0)]."""
-    mu, sd = predict(state, xq)
+def ei_from_moments(mu: jax.Array, sd: jax.Array,
+                    best: jax.Array) -> jax.Array:
+    """EI for minimization from predictive moments: E[max(best - f, 0)].
+    The single EI implementation — GP, MLP-ensemble, and host callers all
+    route here (jnp ops accept numpy inputs)."""
+    sd = jnp.maximum(sd, 1e-9)
     z = (best - mu) / sd
     pdf = jnp.exp(-0.5 * z * z) / math.sqrt(2 * math.pi)
     cdf = 0.5 * (1.0 + jax.scipy.special.erf(z / math.sqrt(2.0)))
     return (best - mu) * cdf + sd * pdf
+
+
+def expected_improvement(state: GPState, xq: jax.Array,
+                         best: jax.Array) -> jax.Array:
+    """EI for minimization: E[max(best - f, 0)]."""
+    mu, sd = predict(state, xq)
+    return ei_from_moments(mu, sd, best)
 
 
 def lower_confidence_bound(state: GPState, xq: jax.Array,

@@ -34,11 +34,15 @@ class SurrogateManager:
                  explore_frac: float = 0.1, max_points: int = 1024,
                  n_members: int = 4, seed: int = 0,
                  hyper_fit: bool = True, select: str = "threshold",
-                 keep_frac: float = 0.25):
+                 keep_frac: float = 0.25, score: str = "lcb",
+                 propose_batch: int = 0, propose_every: int = 2,
+                 pool_mult: int = 32):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         if select not in ("threshold", "topk"):
             raise ValueError(f"unknown select mode {select!r}")
+        if score not in ("lcb", "ei"):
+            raise ValueError(f"unknown score {score!r}; known: lcb, ei")
         # select='threshold': drop candidates predicted worse than the
         # keep_quantile of history (the reference's multivoting,
         # api.py:307-326).  select='topk': keep only the best keep_frac
@@ -47,6 +51,18 @@ class SurrogateManager:
         # proposal stream is already decent.
         self.select = select
         self.keep_frac = keep_frac
+        # score='lcb' ranks candidates by mean - 2*std; 'ei' by expected
+        # improvement over the incumbent — better calibrated exploration
+        # when topk concentration is aggressive (keep_frac < 0.5)
+        self.score_kind = score
+        # propose_batch > 0 turns on the surrogate PROPOSAL plane: every
+        # `propose_every`-th acquisition the manager emits its own
+        # EI-maximizing batch from an oversampled pool (see propose_pool)
+        # instead of only filtering technique batches
+        self.propose_batch = propose_batch
+        self.propose_every = propose_every
+        self.pool_mult = pool_mult
+        self._pool_jit = None
         self.space = space
         self.kind = kind
         self.min_points = min_points
@@ -63,11 +79,13 @@ class SurrogateManager:
         self._key = jax.random.PRNGKey(seed)
         self._threshold = None
 
+        self._best_y = None  # min finite observed y (engine orientation)
         if kind == "gp":
             self._fit = jax.jit(
                 gp_mod.fit_auto if hyper_fit
                 else lambda x, y, mask: gp_mod.fit(x, y, mask=mask))
             self._score = jax.jit(gp_mod.lower_confidence_bound)
+            self._score_ei = jax.jit(gp_mod.expected_improvement)
         else:
             self._fit = jax.jit(lambda k, x, y, mask: mlp_mod.fit(
                 k, x, y, n_members=n_members, mask=mask))
@@ -118,6 +136,7 @@ class SurrogateManager:
         finite = [v for v in self._ys if np.isfinite(v)]
         self._threshold = float(
             np.quantile(finite, self.keep_quantile)) if finite else None
+        self._best_y = float(np.min(finite)) if finite else None
         self._since_fit = 0
         return True
 
@@ -134,11 +153,20 @@ class SurrogateManager:
             return None
         feats = self.space.features(cands)
         preds = None
+        use_ei = (self.select == "topk" and self.score_kind == "ei"
+                  and self._best_y is not None)
         if self.kind == "gp":
-            score = np.asarray(self._score(self._state, feats))
+            if use_ei:
+                score = -np.asarray(self._score_ei(
+                    self._state, feats, jnp.float32(self._best_y)))
+            else:
+                score = np.asarray(self._score(self._state, feats))
         else:
             preds = np.asarray(self._score(self._state, feats))  # [E, B]
             score = preds.mean(axis=0)
+            if use_ei:
+                score = -np.asarray(gp_mod.ei_from_moments(
+                    score, preds.std(axis=0), self._best_y))
         if self.select == "topk":
             b = score.shape[0]
             if candidate_mask is not None:
@@ -162,3 +190,75 @@ class SurrogateManager:
         if candidate_mask is not None:
             explore = explore & np.asarray(candidate_mask)
         return keep | explore
+
+    # ------------------------------------------------------------------
+    # surrogate proposal plane: EI-maximizing batches from an oversampled
+    # pool.  Where keep_mask only FILTERS technique batches (the
+    # reference's multivoting role), this is BO-style acquisition
+    # maximization over a discrete candidate set — scoring thousands of
+    # pool points is nearly free on TPU, so the evaluated batch
+    # concentrates on the acquisition optimum instead of the best half of
+    # whatever one technique happened to propose.
+    def _build_pool_fn(self):
+        space = self.space
+        n_out = self.propose_batch
+        pool = max(n_out * self.pool_mult, n_out)
+        n_rand = max(pool // 4, 1)       # global exploration share
+        n_local = pool - n_rand          # cloud around the incumbent
+        kind = self.kind
+        score_ei = self.score_kind == "ei"
+        from ..ops import perm as perm_ops
+
+        def pool_fn(state, key, best_u, best_perms, best_y):
+            kr, kn, ks, kp = jax.random.split(key, 4)
+            rand = space.random(kr, n_rand)
+            # per-row radius log-uniform over [2^-9, 2^-1.5] of the unit
+            # cube: a multi-scale cloud (coarse jumps through fine local
+            # refinement) — discrete lanes round to neighbours, float
+            # lanes anneal toward the optimum
+            r = jnp.exp2(jax.random.uniform(
+                ks, (n_local, 1), minval=-9.0, maxval=-1.5))
+            noise = jax.random.normal(
+                kn, (n_local, space.n_scalar)) * r
+            u_loc = jnp.clip(best_u[None, :] + noise, 0.0, 1.0)
+            perms_loc = []
+            for i, size in enumerate(space.perm_sizes):
+                base = jnp.tile(best_perms[i][None, :], (n_local, 1))
+                kp, k1, k2 = jax.random.split(kp, 3)
+                mut = perm_ops.small_random_change_batch(
+                    k1, base, 2.0 / max(size, 2))
+                shuf = perm_ops.shuffle_batch(jax.random.fold_in(k2, i),
+                                              base)
+                coin = jax.random.uniform(k2, (n_local, 1)) < 0.75
+                perms_loc.append(
+                    jnp.where(coin, mut, shuf).astype(jnp.int32))
+            local = CandBatch(u_loc, tuple(perms_loc))
+            cands = space.normalize(rand.concat(local))
+            feats = space.features(cands)
+            if kind == "gp":
+                if score_ei:
+                    score = -gp_mod.expected_improvement(
+                        state, feats, best_y)
+                else:
+                    score = gp_mod.lower_confidence_bound(state, feats)
+            else:
+                preds = mlp_mod.predict_members(state, feats)
+                mu, sd = preds.mean(0), preds.std(0)
+                if score_ei:
+                    score = -gp_mod.ei_from_moments(mu, sd, best_y)
+                else:
+                    score = mu - 2.0 * sd
+            idx = jnp.argsort(score)[:n_out]
+            return cands[idx]
+
+        return jax.jit(pool_fn)
+
+    def propose_pool(self, key, best_u, best_perms, best_y):
+        """EI-maximizing CandBatch of `propose_batch` candidates, or None
+        when disabled / not yet fitted."""
+        if self.propose_batch <= 0 or not self.fitted:
+            return None
+        if self._pool_jit is None:
+            self._pool_jit = self._build_pool_fn()
+        return self._pool_jit(self._state, key, best_u, best_perms,
+                              jnp.asarray(best_y, jnp.float32))
