@@ -1,0 +1,46 @@
+"""HPL (Linpack) HPL.dat tuning — the shape of the reference sample
+(/root/reference/samples/hpl/hpl.py: 13 IntegerParameters rendered into
+HPL.dat via a Mako template, minimizing measured solve time), over a
+deterministic synthetic performance model since no xhpl/MPI stack ships
+in this image.
+
+The space mirrors the reference's manipulator one-for-one (blocksize,
+pmapping, pfact, nbmin, ndiv, rfact, bcast, depth, swap,
+swapping_threshold, L1/U transposed, mem_alignment).  The synthetic
+model rewards the interactions real HPL runs exhibit: a blocksize sweet
+spot that shifts with depth, bcast algorithms that only pay off at
+depth>0, and alignment/threshold penalties.
+
+    ut samples/hpl/hpl.py -pf 2 --test-limit 150
+"""
+import uptune_tpu as ut
+
+nb = ut.tune(1, (1, 64), name="blocksize")
+pmap = ut.tune(0, (0, 1), name="row_or_colmajor_pmapping")
+pfact = ut.tune(0, (0, 2), name="pfact")
+nbmin = ut.tune(1, (1, 4), name="nbmin")
+ndiv = ut.tune(2, (2, 2), name="ndiv")
+rfact = ut.tune(0, (0, 4), name="rfact")
+bcast = ut.tune(0, (0, 5), name="bcast")
+depth = ut.tune(0, (0, 4), name="depth")
+swap = ut.tune(0, (0, 2), name="swap")
+swap_thresh = ut.tune(64, (64, 128), name="swapping_threshold")
+l1t = ut.tune(0, (0, 1), name="L1_transposed")
+ut_t = ut.tune(0, (0, 1), name="U_transposed")
+align = ut.tune(4, (4, 16), name="mem_alignment")
+
+# synthetic solve time (seconds): GEMM efficiency peaks at a
+# depth-dependent blocksize; pipelined bcasts (4/5) only help with
+# lookahead depth; panel factorization knobs interact mildly
+best_nb = 28 + 6 * depth
+t = 10.0 + 0.004 * (nb - best_nb) ** 2
+t += 0.35 * abs(depth - 2)
+t += (0.8 if bcast in (4, 5) and depth == 0 else 0.0)
+t -= (0.6 if bcast in (4, 5) and depth >= 2 else 0.0)
+t += 0.15 * pfact + 0.08 * abs(rfact - 2) + 0.05 * (nbmin - 1)
+t += 0.002 * abs(swap_thresh - 96) + 0.2 * (swap == 0)
+t += 0.25 * (align % 8 != 0) + 0.1 * (pmap == 1)
+t -= 0.15 * (l1t == ut_t)
+
+ut.target(t, "min")
+print(f"NB={nb} depth={depth} bcast={bcast} -> t={t:.3f}s")

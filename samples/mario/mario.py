@@ -1,0 +1,56 @@
+"""Super-Mario-style button-sequence tuning — the shape of the
+reference's mario sample (/root/reference/samples/mario/mario.py: tune a
+button-press movie, replay it in an NES emulator, maximize distance
+travelled before death), over a deterministic mini-platformer since no
+emulator ships in this image.
+
+The space: one action per time slot (run / short hop / long jump).  The
+course is a fixed sequence of gaps and walls; kinematics are integer
+steps.  Falling into a gap ends the run; bonking a wall costs the cell
+(a later hop can still clear it) — fitness is distance covered,
+maximized.  Like the real thing, late slots only matter if the early
+slots survive, giving the long-horizon credit landscape the emulator
+version exhibits.
+
+    ut samples/mario/mario.py -pf 2 --test-limit 300
+"""
+import uptune_tpu as ut
+
+SLOTS = 24
+# course features by x-position: gaps must be jumped over, walls need a
+# hop exactly at the approach cell
+GAPS = {7, 8, 19, 20, 21, 33, 46, 47}
+WALLS = {13, 27, 40}
+COURSE_LEN = 56
+
+actions = [ut.tune("run", ["run", "hop", "jump"], name=f"a{i}")
+           for i in range(SLOTS)]
+
+x = 0
+air = 0          # cells of airtime remaining
+dist = 0
+for a in actions:
+    if air == 0:
+        if a == "hop":
+            air = 2
+        elif a == "jump":
+            air = 4
+    step = 2 if air else 1          # airborne carries momentum
+    for _ in range(step):
+        x += 1
+        if x >= COURSE_LEN:
+            break
+        if x in GAPS and air == 0:
+            x = -1                  # fell: run over
+            break
+        if x in WALLS and air == 0:
+            x -= 1                  # bonk: lose the cell
+            break
+    if x < 0 or x >= COURSE_LEN:
+        break
+    air = max(0, air - 1)
+    dist = max(dist, x)
+
+fitness = COURSE_LEN if x >= COURSE_LEN else max(0, dist)
+ut.target(float(fitness), "max")
+print(f"distance {fitness}/{COURSE_LEN}")

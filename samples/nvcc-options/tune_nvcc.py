@@ -1,0 +1,40 @@
+"""NVCC compiler-flag tuning — the shape of the reference sample
+(/root/reference/samples/nvcc-options/tune_nvcc.py: -use_fast_math,
+--maxrregcount, optimization level etc. on CUDA kernels, minimizing
+measured kernel time), over a deterministic synthetic occupancy model
+since no CUDA toolchain ships in this image.
+
+The space mirrors the reference's flags; the model captures the real
+trade-off those flags move: register cap vs. occupancy vs. spills, fast
+math vs. transcendental throughput, block size vs. tail effect.
+
+    ut samples/nvcc-options/tune_nvcc.py -pf 2 --test-limit 150
+"""
+import uptune_tpu as ut
+
+olevel = ut.tune("-O2", ["-O0", "-O1", "-O2", "-O3"], name="olevel")
+fast_math = ut.tune(False, [True, False], name="use_fast_math")
+maxrreg = ut.tune(64, (16, 255), name="maxrregcount")
+block = ut.tune(128, [32, 64, 128, 256, 512, 1024], name="block_size")
+ftz = ut.tune(False, [True, False], name="ftz")
+prec_div = ut.tune(True, [True, False], name="prec_div")
+lineinfo = ut.tune(False, [True, False], name="lineinfo")
+
+KERNEL_REGS = 72        # natural register need of the kernel
+SM_REGS = 65536
+
+# occupancy: warps per SM limited by the register cap
+regs = min(KERNEL_REGS, maxrreg)
+spill = max(0, KERNEL_REGS - maxrreg)
+warps = min(48, SM_REGS // (regs * 32), 2048 // block * (block // 32))
+t = 100.0 / max(1, warps)                      # latency hiding
+t += 0.35 * spill                              # local-memory spills
+t += {"-O0": 3.0, "-O1": 1.0, "-O2": 0.0, "-O3": -0.2}[olevel]
+t -= 1.2 if fast_math else 0.0
+t -= 0.3 if ftz else 0.0
+t += 0.5 if prec_div else 0.0                  # precise division is slow
+t += 0.2 if lineinfo else 0.0                  # debug info inhibits opts
+t += 0.8 if block >= 512 else 0.0              # tail effect on this grid
+
+ut.target(t, "min")
+print(f"{olevel} rreg={maxrreg} block={block} -> {t:.2f} ms")

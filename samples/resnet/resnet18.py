@@ -1,0 +1,54 @@
+"""ResNet-18 FPGA schedule tuning — the shape of the reference sample
+(/root/reference/samples/resnet/resnet18.py: choose HeteroCL scheduling
+primitives per conv layer for an FPGA backend), over a deterministic
+synthetic latency model since HeteroCL and an FPGA toolchain are not in
+this image.
+
+Per conv stage: a scheduling primitive (baseline / reorder / tile /
+unroll+pipeline), a pow2 tile size, and an unroll factor.  The model
+rewards pipelining late (wide) layers and tiling early (large-feature)
+layers — the split real schedules converge to — under a LUT budget that
+rules out unrolling everything.
+
+    ut samples/resnet/resnet18.py -pf 2 --test-limit 200
+"""
+import uptune_tpu as ut
+
+# (name, feature-map size, channels) for the 8 residual-block stages
+STAGES = [("c1", 56, 64), ("c2", 56, 64), ("c3", 28, 128),
+          ("c4", 28, 128), ("c5", 14, 256), ("c6", 14, 256),
+          ("c7", 7, 512), ("c8", 7, 512)]
+LUT_BUDGET = 120_000
+
+total_lat = 0.0
+total_lut = 0.0
+for name, fmap, ch in STAGES:
+    prim = ut.tune("baseline",
+                   ["baseline", "reorder", "tile", "pipeline"],
+                   name=f"{name}_prim")
+    tile = ut.tune(8, [4, 8, 16, 32], name=f"{name}_tile")
+    unroll = ut.tune(1, [1, 2, 4, 8], name=f"{name}_unroll")
+
+    work = fmap * fmap * ch * 9.0 / 1e3          # MACs (scaled)
+    lat = work
+    lut = 2000.0
+    if prim == "reorder":
+        lat *= 0.85
+    elif prim == "tile":
+        # tiling pays off on large feature maps when the tile fits
+        lat *= 0.55 if fmap >= 28 and tile <= fmap // 2 else 0.95
+        lut += 60 * tile
+    elif prim == "pipeline":
+        # pipelining pays off on deep/narrow layers; area scales with
+        # unroll
+        lat *= (0.35 if fmap <= 14 else 0.8) / unroll
+        lut += 900 * unroll + 40 * tile
+    total_lat += lat
+    total_lut += lut
+
+# over-budget designs fail timing closure: steep penalty, as in real
+# flows (the reference reports inf on failed builds)
+qor = total_lat + max(0.0, total_lut - LUT_BUDGET) * 0.05
+
+ut.target(qor, "min")
+print(f"latency={total_lat:.1f} LUT={total_lut:.0f} qor={qor:.1f}")

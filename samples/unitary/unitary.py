@@ -1,0 +1,69 @@
+"""Quantum-control unitary synthesis in SU(2) — the reference's unitary
+sample (/root/reference/samples/unitary/unitary.py: choose, from a finite
+control set, an operator sequence whose product approximates a goal
+unitary in minimal time, within an admissible error).
+
+Unlike most EDA samples this one is fully computable here: the payload
+is 2x2 complex matrix products.  Each of SEQ_LEN slots picks a control
+(one of two rotation generators, or idle) and a duration; QoR is the
+infidelity to the goal plus a small total-time penalty, so the tuner
+must hit the target AND do it fast — the reference's "optimal time"
+objective.
+
+    ut samples/unitary/unitary.py -pf 2 --test-limit 200
+"""
+import cmath
+import math
+
+import uptune_tpu as ut
+
+SEQ_LEN = 8
+
+# control set: rotations about x and y at fixed Rabi rate, plus idle
+# (free evolution is a z-rotation at the detuning rate)
+def rx(theta):
+    c, s = math.cos(theta / 2), math.sin(theta / 2)
+    return ((c, -1j * s), (-1j * s, c))
+
+
+def ry(theta):
+    c, s = math.cos(theta / 2), math.sin(theta / 2)
+    return ((c, -s), (s, c))
+
+
+def rz(theta):
+    return ((cmath.exp(-0.5j * theta), 0), (0, cmath.exp(0.5j * theta)))
+
+
+def mm(a, b):
+    return tuple(tuple(sum(a[i][k] * b[k][j] for k in range(2))
+                       for j in range(2)) for i in range(2))
+
+
+# goal: the reference's 'fixed' Ugoal shape — a specific SU(2) element
+# reachable only by composing both generators
+U_GOAL = mm(rx(1.9), mm(ry(0.7), rz(1.3)))
+
+u = ((1, 0), (0, 1))
+total_t = 0.0
+for i in range(SEQ_LEN):
+    ctrl = ut.tune("idle", ["x", "y", "idle"], name=f"ctrl{i}")
+    dt = ut.tune(0.0, (0.0, math.pi), name=f"dt{i}")
+    if ctrl == "x":
+        u = mm(rx(dt), u)
+        total_t += dt
+    elif ctrl == "y":
+        u = mm(ry(dt), u)
+        total_t += dt
+    else:
+        u = mm(rz(0.15 * dt), u)  # idle: slow free precession
+        total_t += dt
+
+# gauge-invariant fidelity |tr(U† Ugoal)| / 2
+tr = sum(u[j][i].conjugate() * U_GOAL[j][i] for i in range(2)
+         for j in range(2))
+infidelity = 1.0 - abs(tr) / 2.0
+qor = infidelity + 0.01 * total_t
+
+ut.target(qor, "min")
+print(f"infidelity={infidelity:.4f} time={total_t:.2f} qor={qor:.4f}")
