@@ -219,7 +219,7 @@ def _load_state(path):
 
 
 def run_suite(problems, seeds: int, budget_scale: float = 1.0,
-              state_path: str = None):
+              state_path: str = None, modes=("baseline", "tpu")):
     """Per-run results checkpoint to `state_path` (jsonl) so a crashed
     sweep resumes instead of redoing hours of runs."""
     done = _load_state(state_path)
@@ -227,7 +227,7 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
     rows = []
     for prob in problems:
         budget = int(PROBLEMS[prob]()[3] * budget_scale)
-        for mode in ("baseline", "tpu"):
+        for mode in modes:
             per_seed = []
             for s in range(seeds):
                 key = (prob, mode, 1000 + s)
@@ -266,7 +266,7 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
             iters = np.asarray([r["iters"] for r in per_seed])
             rows.append({
                 "problem": prob, "mode": mode, "seeds": seeds,
-                "budget": budget,
+                "budget": budget, "sopts_sig": _sopts_sig(mode),
                 "median_iters": float(np.median(iters)),
                 "iqr": [float(np.percentile(iters, 25)),
                         float(np.percentile(iters, 75))],
@@ -277,6 +277,10 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
 
 
 def to_markdown(rows, seeds):
+    # per-row seed counts are authoritative (merged rows may have been
+    # measured at a different count than this invocation's --seeds)
+    counts = sorted({r["seeds"] for r in rows}) or [seeds]
+    seeds_txt = "/".join(str(c) for c in counts)
     lines = [
         "# BENCHREPORT — iterations-to-optimum",
         "",
@@ -288,8 +292,11 @@ def to_markdown(rows, seeds):
         "surrogate plane: EI top-k batch concentration plus",
         "EI-maximizing proposal batches from an oversampled pool",
         "(surrogate/manager.py propose_pool) every other acquisition.",
-        f"{seeds} seeds per cell.  Regenerate:",
-        "`python scripts/benchreport.py --seeds 30 --out BENCHREPORT.md`.",
+        f"{seeds_txt} seeds per cell.  Regenerate (one mode at a time is",
+        "fine; aggregate rows persist in benchreport_rows.jsonl):",
+        "`python scripts/benchreport.py --seeds 30 [--modes tpu]",
+        "--state benchreport_state.jsonl --rows benchreport_rows.jsonl",
+        "--out BENCHREPORT.md`.",
         "",
         "| problem | mode | median iters | IQR | censored/seeds |",
         "|---|---|---|---|---|",
@@ -319,16 +326,55 @@ if __name__ == "__main__":
     ap.add_argument("--quick", action="store_true",
                     help="3 seeds, smaller budgets, rosenbrock-2d only")
     ap.add_argument("--problems", nargs="*", default=None)
+    ap.add_argument("--modes", nargs="*", default=["baseline", "tpu"],
+                    choices=["baseline", "tpu"])
     ap.add_argument("--out", default=None, help="write markdown here")
     ap.add_argument("--state", default=None,
                     help="per-run checkpoint jsonl (resume after crash)")
+    ap.add_argument("--rows", default=None,
+                    help="aggregate-rows jsonl: rows for modes NOT being "
+                         "re-run are loaded from here, and all rows are "
+                         "written back — lets one mode be re-measured "
+                         "without redoing the other's sweep")
     args = ap.parse_args()
     problems = args.problems or (
         ["rosenbrock-2d"] if args.quick else list(PROBLEMS))
     seeds = 3 if args.quick else args.seeds
     rows = run_suite(problems, seeds,
                      budget_scale=0.5 if args.quick else 1.0,
-                     state_path=args.state)
+                     state_path=args.state, modes=args.modes)
+    if args.rows:
+        prior = []
+        if os.path.exists(args.rows):
+            with open(args.rows) as f:
+                prior = [json.loads(ln) for ln in f if ln.strip()]
+        fresh = {(r["problem"], r["mode"]) for r in rows}
+        scale = 0.5 if args.quick else 1.0
+        kept, dropped = [], []
+        for r in prior:
+            if (r["problem"], r["mode"]) in fresh:
+                continue
+            # the same staleness guards as the per-run state file:
+            # never merge rows measured at another budget or under
+            # other tpu-mode settings into the published table
+            cur_budget = (int(PROBLEMS[r["problem"]]()[3] * scale)
+                          if r["problem"] in PROBLEMS else None)
+            if (r.get("budget") != cur_budget
+                    or r.get("sopts_sig") != _sopts_sig(r["mode"])):
+                dropped.append(r)
+            else:
+                kept.append(r)
+        for r in dropped:
+            print(f"rows: dropped stale {r['problem']}/{r['mode']} "
+                  f"(budget/settings mismatch) — re-run that mode",
+                  file=sys.stderr)
+        rows = kept + rows
+        order = {p: i for i, p in enumerate(PROBLEMS)}
+        rows.sort(key=lambda r: (order.get(r["problem"], len(order)),
+                                 r["mode"]))
+        with open(args.rows, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
     if args.out:
         with open(args.out, "w") as f:
             f.write(to_markdown(rows, seeds))

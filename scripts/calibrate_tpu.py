@@ -26,6 +26,11 @@ VARIANTS = {
     "pb16": {"propose_batch": 16},
     "every3": {"propose_every": 3},
     "lcb-pool": {"score": "lcb"},
+    "minp32": {"min_points": 32, "refit_interval": 32},
+    "pool128": {"pool_mult": 128},
+    "minp8": {"min_points": 8, "refit_interval": 8},
+    "kf35": {"keep_frac": 0.35},
+    "kf25": {"keep_frac": 0.25},
 }
 
 

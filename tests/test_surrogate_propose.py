@@ -74,6 +74,38 @@ class TestProposePool:
         assert picked.mean() < rand.mean() / 2, (picked.mean(),
                                                  rand.mean())
 
+    def test_pool_concentrates_on_flag_space(self):
+        """gcc-options-shaped landscape: mostly-boolean lanes with
+        additive effects.  The sparse-lane-resample rows must let EI
+        find better-than-random candidates around the incumbent (dense
+        Gaussian moves alone either round back to the incumbent or jump
+        uniformly far on such spaces)."""
+        from uptune_tpu.space.params import BoolParam
+        rng = np.random.RandomState(0)
+        space = Space([BoolParam(f"f{i}") for i in range(48)])
+        w = rng.randn(48) * 0.5
+
+        def qor_of(u):
+            flags = np.round(np.asarray(u))
+            return 5.0 + flags @ w
+
+        m = SurrogateManager(space, "gp", min_points=48,
+                             explore_frac=0.0, propose_batch=16,
+                             score="ei", pool_mult=32)
+        cands = space.random(jax.random.PRNGKey(0), 192)
+        qor = qor_of(cands.u)
+        m.observe(np.asarray(space.features(cands)), qor)
+        assert m.maybe_refit()
+        i = int(np.argmin(qor))
+        out = m.propose_pool(jax.random.PRNGKey(1), cands.u[i], (),
+                             float(qor[i]))
+        picked = qor_of(out.u)
+        rand = qor_of(space.random(jax.random.PRNGKey(2), 512).u)
+        # picked batch must improve on random sampling AND contain
+        # something at least as good as the incumbent's neighbourhood
+        assert picked.mean() < rand.mean(), (picked.mean(), rand.mean())
+        assert picked.min() <= qor[i], (picked.min(), qor[i])
+
     def test_pool_perm_rows_are_permutations(self):
         space = Space([FloatParam("a", 0, 1),
                        PermParam("p", tuple(range(7)))])

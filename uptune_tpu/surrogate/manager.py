@@ -205,22 +205,43 @@ class SurrogateManager:
         pool = max(n_out * self.pool_mult, n_out)
         n_rand = max(pool // 4, 1)       # global exploration share
         n_local = pool - n_rand          # cloud around the incumbent
+        # local rows split between two move families: dense Gaussian
+        # clouds (continuous refinement — rosenbrock-style landscapes)
+        # and sparse lane resampling (flip a few flags / re-draw a few
+        # ints around the incumbent — gcc-options-style landscapes,
+        # where perturbing all 200 lanes at once either rounds back to
+        # the incumbent or jumps uniformly far)
+        n_dense = n_local // 2
+        n_sparse = n_local - n_dense
         kind = self.kind
         score_ei = self.score_kind == "ei"
         from ..ops import perm as perm_ops
 
         def pool_fn(state, key, best_u, best_perms, best_y):
-            kr, kn, ks, kp = jax.random.split(key, 4)
+            kr, kn, ks, kp, km, kv, kw = jax.random.split(key, 7)
             rand = space.random(kr, n_rand)
-            # per-row radius log-uniform over [2^-9, 2^-1.5] of the unit
-            # cube: a multi-scale cloud (coarse jumps through fine local
-            # refinement) — discrete lanes round to neighbours, float
-            # lanes anneal toward the optimum
+            # dense: per-row radius log-uniform over [2^-9, 2^-1.5] of
+            # the unit cube — a multi-scale cloud (coarse jumps through
+            # fine local refinement); discrete lanes round to
+            # neighbours, float lanes anneal toward the optimum
             r = jnp.exp2(jax.random.uniform(
-                ks, (n_local, 1), minval=-9.0, maxval=-1.5))
+                ks, (n_dense, 1), minval=-9.0, maxval=-1.5))
             noise = jax.random.normal(
-                kn, (n_local, space.n_scalar)) * r
-            u_loc = jnp.clip(best_u[None, :] + noise, 0.0, 1.0)
+                kn, (n_dense, space.n_scalar)) * r
+            u_dense = jnp.clip(best_u[None, :] + noise, 0.0, 1.0)
+            # sparse: per-row lane-selection rate log-uniform between
+            # ~1 lane and a quarter of the lanes; selected lanes re-draw
+            # uniformly, the rest stay at the incumbent
+            d = max(space.n_scalar, 1)
+            lo_rate = -float(np.log2(d))
+            rate = jnp.exp2(jax.random.uniform(
+                km, (n_sparse, 1),
+                minval=lo_rate, maxval=max(-2.0, lo_rate)))
+            flip = jax.random.uniform(kv, (n_sparse, d)) < rate
+            u_sparse = jnp.where(
+                flip, jax.random.uniform(kw, (n_sparse, d)),
+                best_u[None, :])
+            u_loc = jnp.concatenate([u_dense, u_sparse], axis=0)
             perms_loc = []
             for i, size in enumerate(space.perm_sizes):
                 base = jnp.tile(best_perms[i][None, :], (n_local, 1))
