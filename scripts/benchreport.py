@@ -164,11 +164,14 @@ TPU_SOPTS = {
     # top-k batch concentration + the surrogate proposal plane
     # (EI-maximizing batches from an oversampled pool, every other
     # acquisition once fitted).  Settings selected by the calibration
-    # grid (scripts/calibrate_tpu.py): keep_frac 0.25 over-exploits and
-    # censors on rosenbrock-4d; 0.5 wins on every space tested; the
-    # proposal plane is where the big iters-to-optimum cut comes from.
+    # grid (scripts/calibrate_tpu.py): with the proposal plane carrying
+    # exploitation, arm batches prune harder than the filter-only era
+    # could afford (keep_frac 0.25 used to censor rosenbrock-4d; now
+    # 0.25-0.5 all work, 0.35 is the across-space compromise), EI beats
+    # LCB for top-k ranking, and the sparse-lane pool moves are what
+    # carry gcc-options-shaped spaces.
     "min_points": 16, "refit_interval": 16, "max_points": 256,
-    "select": "topk", "keep_frac": 0.5, "explore_frac": 0.1,
+    "select": "topk", "keep_frac": 0.35, "explore_frac": 0.1,
     "score": "ei", "propose_batch": 8, "propose_every": 2,
     "pool_mult": 64,
 }
@@ -353,6 +356,7 @@ if __name__ == "__main__":
         kept, dropped = [], []
         for r in prior:
             if (r["problem"], r["mode"]) in fresh:
+                dropped.append(r)  # superseded by this invocation
                 continue
             # the same staleness guards as the per-run state file:
             # never merge rows measured at another budget or under
@@ -364,10 +368,17 @@ if __name__ == "__main__":
                 dropped.append(r)
             else:
                 kept.append(r)
-        for r in dropped:
-            print(f"rows: dropped stale {r['problem']}/{r['mode']} "
-                  f"(budget/settings mismatch) — re-run that mode",
-                  file=sys.stderr)
+        if dropped:
+            # excluded rows are preserved, not destroyed: a --quick
+            # invocation pointed at the published rows file must never
+            # delete the 30-seed sweep results it mismatches
+            with open(args.rows + ".stale", "a") as f:
+                for r in dropped:
+                    f.write(json.dumps(r) + "\n")
+                    print(f"rows: excluded {r['problem']}/{r['mode']} "
+                          f"(budget/settings mismatch or superseded); "
+                          f"preserved in {args.rows}.stale",
+                          file=sys.stderr)
         rows = kept + rows
         order = {p: i for i, p in enumerate(PROBLEMS)}
         rows.sort(key=lambda r: (order.get(r["problem"], len(order)),

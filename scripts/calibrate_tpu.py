@@ -29,7 +29,7 @@ VARIANTS = {
     "minp32": {"min_points": 32, "refit_interval": 32},
     "pool128": {"pool_mult": 128},
     "minp8": {"min_points": 8, "refit_interval": 8},
-    "kf35": {"keep_frac": 0.35},
+    "kf50": {"keep_frac": 0.5},
     "kf25": {"keep_frac": 0.25},
 }
 
