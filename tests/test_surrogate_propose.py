@@ -165,6 +165,28 @@ class TestTunerSurrogateTickets:
         # repeat evaluation would overshoot the count
         assert t.evals <= 256
 
+    def test_resume_warms_surrogate(self, tmp_path):
+        """Archive replay must feed the surrogate training set — a
+        resumed run's GP starts fitted, not cold (the reference's
+        resume() replays into the DBs its surrogate trains from,
+        api.py:341-363)."""
+        space = rosenbrock_space(2, -2.048, 2.048)
+        obj = rosenbrock_objective(2)
+        arch = str(tmp_path / "a.jsonl")
+        t = Tuner(space, obj, seed=3, surrogate="gp",
+                  surrogate_opts=self._opts(), archive=arch)
+        t.run(test_limit=80)
+        t.close()
+        t2 = Tuner(space, obj, seed=4, surrogate="gp",
+                   surrogate_opts=self._opts(), archive=arch,
+                   resume=True)
+        assert t2.evals >= 80
+        assert t2.surrogate.fitted, "surrogate cold after resume"
+        # and the proposal plane engages on the very first acquisitions
+        t2.run(test_limit=t2.evals + 40)
+        assert "surrogate" in t2.arm_stats, t2.arm_stats
+        t2.close()
+
     def test_faster_than_filter_only_on_fixed_seed(self):
         """The proposal plane must beat the filter-only surrogate config
         on a fixed seed (the BENCHREPORT improvement, in-miniature)."""

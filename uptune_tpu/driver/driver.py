@@ -324,6 +324,14 @@ class Tuner:
         self.hist_state, self.best = self._commit(
             self.hist_state, self.best, hashes, cands, jnp.asarray(qor),
             novel)
+        if self.surrogate is not None:
+            # replayed trials are training data too: without this the
+            # surrogate restarts cold after every resume while the
+            # techniques resume warm (reference resume() replays into
+            # the DBs its surrogate trains from, api.py:341-363)
+            self.surrogate.observe(
+                np.asarray(self.space.features(cands)), qor)
+            self.surrogate.maybe_refit()
         self.gid = max(int(r["gid"]) for r in rows) + 1
         self.evals = len(rows)
         self.told = len(rows)
