@@ -160,21 +160,20 @@ def iters_to_threshold(trace, thresh: float, budget: int) -> int:
     return budget  # censored
 
 
-TPU_SOPTS = {
-    # top-k batch concentration + the surrogate proposal plane
-    # (EI-maximizing batches from an oversampled pool, every other
-    # acquisition once fitted).  Settings selected by the calibration
-    # grid (scripts/calibrate_tpu.py): with the proposal plane carrying
-    # exploitation, arm batches prune harder than the filter-only era
-    # could afford (keep_frac 0.25 used to censor rosenbrock-4d; now
-    # 0.25-0.5 all work, 0.35 is the across-space compromise), EI beats
-    # LCB for top-k ranking, and the sparse-lane pool moves are what
-    # carry gcc-options-shaped spaces.
-    "min_points": 16, "refit_interval": 16, "max_points": 256,
-    "select": "topk", "keep_frac": 0.35, "explore_frac": 0.1,
-    "score": "ei", "propose_batch": 8, "propose_every": 2,
-    "pool_mult": 64,
-}
+# The calibrated settings are the package-level defaults (selected by
+# scripts/calibrate_tpu.py, validated at 30 seeds in BENCHREPORT.md):
+# with the proposal plane carrying exploitation, arm batches prune
+# harder than the filter-only era could afford (keep_frac 0.25 used to
+# censor rosenbrock-4d; now 0.25-0.5 all work, 0.35 is the across-space
+# compromise), EI beats LCB for top-k ranking, and the sparse-lane pool
+# moves are what carry gcc-options-shaped spaces.
+# (uptune_tpu.calibrated is deliberately jax-import-free: this module
+# body runs before __main__ installs the cpuenv platform guard.)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from uptune_tpu.calibrated import CALIBRATED_OPTS  # noqa: E402
+
+TPU_SOPTS = dict(CALIBRATED_OPTS)
 
 
 def one_run(problem: str, mode: str, seed: int, budget: int,

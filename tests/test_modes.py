@@ -168,6 +168,20 @@ class TestCLI:
         out2 = self._run(["prog.py", "--apply-best"], str(tmp_path))
         assert out2.returncode == 0, out2.stderr[-800:]
 
+    def test_learning_models_flag(self, tmp_path):
+        """--learning-models gp enables the surrogate plane with the
+        calibrated defaults (the reference's --learning-models,
+        api.py:39-40); trials past min_points are surrogate-guided and
+        the run still completes."""
+        shutil.copy(os.path.join(SAMPLES, "hash", "single_stage.py"),
+                    tmp_path / "prog.py")
+        out = self._run(["prog.py", "-pf", "2", "--test-limit", "24",
+                         "--seed", "3", "--learning-models", "gp"],
+                        str(tmp_path))
+        assert out.returncode == 0, out.stderr[-800:]
+        last = json.loads(out.stdout.strip().splitlines()[-1])
+        assert last["evals"] >= 24
+
     def test_print_search_space_size(self, tmp_path):
         shutil.copy(os.path.join(SAMPLES, "hash", "single_stage.py"),
                     tmp_path / "prog.py")

@@ -1,0 +1,19 @@
+"""Calibrated surrogate settings — importable WITHOUT jax.
+
+Selected by the calibration grid (scripts/calibrate_tpu.py) and
+validated at 30 seeds (BENCHREPORT.md): EI top-k concentration of
+technique batches plus the surrogate proposal plane.  These are the
+defaults the CLI / ProgramTuner apply when a learning model is enabled
+by name; library users override any key via `surrogate_opts`.
+
+This module must stay free of jax imports: benchmark and CLI entry
+points read it before the platform guard (scripts/cpuenv.py) has run,
+and importing jax eagerly can dial the wedgeable axon TPU tunnel.
+"""
+
+CALIBRATED_OPTS = {
+    "min_points": 16, "refit_interval": 16, "max_points": 256,
+    "select": "topk", "keep_frac": 0.35, "explore_frac": 0.1,
+    "score": "ei", "propose_batch": 8, "propose_every": 2,
+    "pool_mult": 64,
+}

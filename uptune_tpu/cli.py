@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SEED",
                    help="use a seeded random AUC-bandit portfolio "
                         "instead of --technique")
+    p.add_argument("--learning-models", action="append", default=None,
+                   choices=("gp", "mlp"),
+                   help="enable the surrogate plane (EI top-k pruning "
+                        "+ pool proposals, calibrated defaults); the "
+                        "reference's --learning-models flag")
     p.add_argument("--seed", type=int, default=None, help="RNG seed")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
@@ -137,13 +142,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         technique = generate_bandit_technique(
             args.generate_bandit_technique)
 
+    # flags > ut.config session settings > none (reference layering,
+    # __init__.py:45-55); the settings key holds a list like the flag
+    models = args.learning_models
+    if models is None:
+        m = settings["learning-model"]
+        models = [m] if isinstance(m, str) else list(m or []) or None
+    surrogate = models[0] if models else None
+    if models and len(models) > 1:
+        log.warning("[ut] only one surrogate runs per tuner; using "
+                    "%r and ignoring %r (the mlp kind is itself an "
+                    "ensemble)", surrogate, models[1:])
+
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,
         parallel=args.parallel_factor, test_limit=args.test_limit,
         runtime_limit=args.runtime_limit, timeout=args.timeout,
         technique=technique, seed=args.seed, params_file=args.params,
         resume=args.resume, sandbox=not args.no_sandbox,
-        template=template)
+        surrogate=surrogate, template=template)
 
     if args.cfg:
         for k in sorted(settings):

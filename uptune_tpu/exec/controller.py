@@ -57,7 +57,8 @@ class ProgramTuner:
                  technique=None, seed: Optional[int] = None,
                  params_file: Optional[str] = None,
                  archive: Optional[str] = None, resume: bool = False,
-                 surrogate=None, env: Optional[Dict[str, str]] = None,
+                 surrogate=None, surrogate_opts: Optional[dict] = None,
+                 env: Optional[Dict[str, str]] = None,
                  sandbox: bool = True,
                  status_interval: Optional[int] = None,
                  template=None, hooks=None):
@@ -94,6 +95,14 @@ class ProgramTuner:
             self.work_dir, "ut.archive.jsonl")
         self.resume = resume
         self.surrogate = surrogate
+        # by-name surrogates get the calibrated defaults (BENCHREPORT
+        # settings) unless the caller overrides
+        if isinstance(surrogate, str):
+            from ..calibrated import CALIBRATED_OPTS
+            self.surrogate_opts = {**CALIBRATED_OPTS,
+                                   **(surrogate_opts or {})}
+        else:
+            self.surrogate_opts = surrogate_opts
         self.env_extra = dict(env or {})
         self.use_sandbox = sandbox
         self.status_interval = (status_interval if status_interval
@@ -176,7 +185,9 @@ class ProgramTuner:
         return Tuner(space, None, technique=self.technique,
                      seed=self.seed, sense=self.sense,
                      archive=self.archive, resume=self.resume,
-                     surrogate=self.surrogate, config_filter=filt,
+                     surrogate=self.surrogate,
+                     surrogate_opts=self.surrogate_opts,
+                     config_filter=filt,
                      hooks=self.hooks)
 
     def _maybe_new_best(self, stats) -> None:

@@ -26,6 +26,10 @@ from . import mlp as mlp_mod
 
 KINDS = ("gp", "mlp")
 
+# re-exported for callers that already import the manager; the source
+# of truth is jax-import-free (see uptune_tpu/calibrated.py)
+from ..calibrated import CALIBRATED_OPTS  # noqa: E402,F401
+
 
 class SurrogateManager:
     def __init__(self, space: Space, kind: str = "gp", *,
