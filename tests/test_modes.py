@@ -168,6 +168,28 @@ class TestCLI:
         out2 = self._run(["prog.py", "--apply-best"], str(tmp_path))
         assert out2.returncode == 0, out2.stderr[-800:]
 
+    def test_learning_model_session_fallback(self, tmp_path):
+        """ProgramTuner honors ut.config({'learning-model': ...}) when
+        no explicit surrogate is passed (the documented settings
+        fallback, same layering as its sibling parameters)."""
+        from uptune_tpu.api.session import settings
+        from uptune_tpu.calibrated import CALIBRATED_OPTS
+        from uptune_tpu.exec.controller import ProgramTuner
+        old = settings["learning-model"]
+        settings["learning-model"] = ["gp"]
+        try:
+            pt = ProgramTuner(["true"], str(tmp_path))
+            assert pt.surrogate == "gp"
+            assert pt.surrogate_opts == CALIBRATED_OPTS
+            # explicit surrogate still wins over the setting
+            pt2 = ProgramTuner(["true"], str(tmp_path),
+                               surrogate="mlp",
+                               surrogate_opts={"keep_frac": 0.5})
+            assert pt2.surrogate == "mlp"
+            assert pt2.surrogate_opts["keep_frac"] == 0.5
+        finally:
+            settings["learning-model"] = old
+
     def test_learning_models_flag(self, tmp_path):
         """--learning-models gp enables the surrogate plane with the
         calibrated defaults (the reference's --learning-models,

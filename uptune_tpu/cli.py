@@ -142,12 +142,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         technique = generate_bandit_technique(
             args.generate_bandit_technique)
 
-    # flags > ut.config session settings > none (reference layering,
-    # __init__.py:45-55); the settings key holds a list like the flag
+    # the flag is this layer's override; when absent, ProgramTuner
+    # itself falls back to the ut.config 'learning-model' setting (the
+    # same flags > settings > defaults layering as its sibling params)
     models = args.learning_models
-    if models is None:
-        m = settings["learning-model"]
-        models = [m] if isinstance(m, str) else list(m or []) or None
     surrogate = models[0] if models else None
     if models and len(models) > 1:
         log.warning("[ut] only one surrogate runs per tuner; using "

@@ -94,6 +94,13 @@ class ProgramTuner:
         self.archive = archive if archive is not None else os.path.join(
             self.work_dir, "ut.archive.jsonl")
         self.resume = resume
+        if surrogate is None:
+            # same flags > ut.config() > defaults layering as the
+            # sibling parameters above; the settings key holds a kind
+            # list (the reference's learning-model list, __init__.py:53)
+            m = settings["learning-model"]
+            models = [m] if isinstance(m, str) else list(m or [])
+            surrogate = models[0] if models else None
         self.surrogate = surrogate
         # by-name surrogates get the calibrated defaults (BENCHREPORT
         # settings) unless the caller overrides

@@ -1,3 +1,4 @@
 from .multihost import (distributed_config, initialize,  # noqa: F401
                         is_coordinator, make_multihost_mesh)
 from .sharded import ShardedEngine, make_mesh  # noqa: F401
+from .surrogate_shard import sharded_gp_score  # noqa: F401
