@@ -101,6 +101,12 @@ class ProgramTuner:
             m = settings["learning-model"]
             models = [m] if isinstance(m, str) else list(m or [])
             surrogate = models[0] if models else None
+            if len(models) > 1:
+                import logging
+                logging.getLogger("uptune_tpu").warning(
+                    "[ut] only one surrogate runs per tuner; using %r "
+                    "and ignoring %r (the mlp kind is itself an "
+                    "ensemble)", surrogate, models[1:])
         self.surrogate = surrogate
         # by-name surrogates get the calibrated defaults (BENCHREPORT
         # settings) unless the caller overrides
