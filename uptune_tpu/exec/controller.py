@@ -102,8 +102,7 @@ class ProgramTuner:
             models = [m] if isinstance(m, str) else list(m or [])
             surrogate = models[0] if models else None
             if len(models) > 1:
-                import logging
-                logging.getLogger("uptune_tpu").warning(
+                log.warning(
                     "[ut] only one surrogate runs per tuner; using %r "
                     "and ignoring %r (the mlp kind is itself an "
                     "ensemble)", surrogate, models[1:])
