@@ -3,7 +3,7 @@ on-device tuning engine.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-     "platform": "tpu"|"cpu"}
+     "platform": "tpu"|"cpu"|"cpu:fallback", "quick": bool}
 
 `vs_baseline` is value / 100_000 — the north-star floor from
 BASELINE.json ("≥100k candidate acquisitions/sec on a v4-8"); the
@@ -85,6 +85,11 @@ def _init_backend(cpu_flag: bool):
 def main() -> None:
     quick = "--quick" in sys.argv
     jax, platform = _init_backend(cpu_flag="--cpu" in sys.argv)
+    if platform == "cpu:fallback":
+        # the fallback number is explicitly labeled and never stands in
+        # for the TPU result; run it at quick size so a wedged tunnel
+        # can't also push the driver's bench step into a timeout
+        quick = True
 
     from uptune_tpu.engine import FusedEngine, default_arms
     from uptune_tpu.workloads import rosenbrock_device, rosenbrock_space
@@ -121,6 +126,7 @@ def main() -> None:
         "unit": "configs/s",
         "vs_baseline": round(rate / 100_000.0, 3),
         "platform": platform,
+        "quick": quick,
     }))
 
 
