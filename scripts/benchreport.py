@@ -12,17 +12,21 @@ implementation of the reference's algorithms:
   surrogate filtering.  Iteration = one black-box evaluation, exactly
   the reference's unit (one config per desired_result() call,
   opentuner/search/driver.py:160-207).
-* tpu mode — the same portfolio plus the TPU-native additions: GP
+* surrogate mode — the same portfolio plus the surrogate plane: GP
   surrogate with marginal-likelihood hyperparameter fitting, EI top-k
   batch concentration (only the predicted-best half of each proposed
   batch is evaluated), and the surrogate PROPOSAL plane — every other
   acquisition the manager emits its own EI-maximizing batch from an
   oversampled pool (uniform + multi-scale incumbent perturbations),
   scored on device where ranking thousands of candidates is free.
+  (This mode was called "tpu" through round 2; renamed because it names
+  an ALGORITHM stack, not the platform it ran on — legacy "tpu" rows in
+  state/rows files are read as "surrogate".)
 
 Metric per run: number of EVALUATIONS until best-so-far reaches the
 space's optimum threshold (censored at the eval budget).  Reported:
-median over seeds, per space and mode, plus the tpu/baseline ratio.
+median over seeds, per space and mode, plus the surrogate/baseline
+ratio.
 
 Spaces:
 * rosenbrock-2d / -4d — the reference's own framework-test fixture
@@ -173,19 +177,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 from uptune_tpu.calibrated import CALIBRATED_OPTS  # noqa: E402
 
-TPU_SOPTS = dict(CALIBRATED_OPTS)
+SURROGATE_SOPTS = dict(CALIBRATED_OPTS)
+
+# pre-round-3 artifacts called surrogate mode "tpu"; normalize on read so
+# published 30-seed rows survive the rename
+_LEGACY_MODES = {"tpu": "surrogate"}
+
+
+def _norm_mode(m: str) -> str:
+    return _LEGACY_MODES.get(m, m)
 
 
 def one_run(problem: str, mode: str, seed: int, budget: int,
             sopts_override: dict = None):
     from uptune_tpu.driver.driver import Tuner
 
+    mode = _norm_mode(mode)
     space, objective, thresh, _ = PROBLEMS[problem]()
     surrogate = None
     sopts = None
-    if mode == "tpu":
+    if mode == "surrogate":
         surrogate = "gp"
-        sopts = dict(TPU_SOPTS)
+        sopts = dict(SURROGATE_SOPTS)
         if sopts_override:
             sopts.update(sopts_override)
     tuner = Tuner(space, objective, seed=seed, surrogate=surrogate,
@@ -202,9 +215,9 @@ def one_run(problem: str, mode: str, seed: int, budget: int,
 
 def _sopts_sig(mode: str):
     """Fingerprint of the settings a cached row was measured under."""
-    if mode != "tpu":
+    if _norm_mode(mode) != "surrogate":
         return "baseline"
-    return json.dumps(TPU_SOPTS, sort_keys=True)
+    return json.dumps(SURROGATE_SOPTS, sort_keys=True)
 
 
 def _load_state(path):
@@ -216,12 +229,13 @@ def _load_state(path):
                     r = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                r["mode"] = _norm_mode(r["mode"])
                 done[(r["problem"], r["mode"], r["seed"])] = r
     return done
 
 
 def run_suite(problems, seeds: int, budget_scale: float = 1.0,
-              state_path: str = None, modes=("baseline", "tpu")):
+              state_path: str = None, modes=("baseline", "surrogate")):
     """Per-run results checkpoint to `state_path` (jsonl) so a crashed
     sweep resumes instead of redoing hours of runs."""
     done = _load_state(state_path)
@@ -229,7 +243,7 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
     rows = []
     for prob in problems:
         budget = int(PROBLEMS[prob]()[3] * budget_scale)
-        for mode in modes:
+        for mode in (_norm_mode(m) for m in modes):
             per_seed = []
             for s in range(seeds):
                 key = (prob, mode, 1000 + s)
@@ -290,13 +304,16 @@ def to_markdown(rows, seeds):
         "optimum threshold (rosenbrock-2d: QoR <= 0.1; -4d: <= 1.0;",
         "gcc-options-shaped: 90% of the greedy-achievable improvement).",
         "`baseline` is the reference's search stack run faithfully",
-        "(AUC-bandit portfolio, no surrogate); `tpu` adds the GP",
+        "(AUC-bandit portfolio, no surrogate); `surrogate` adds the GP",
         "surrogate plane: EI top-k batch concentration plus",
         "EI-maximizing proposal batches from an oversampled pool",
         "(surrogate/manager.py propose_pool) every other acquisition.",
+        "Mode names describe the ALGORITHM stack, not the platform the",
+        "sweep ran on (pre-round-3 artifacts said `tpu` for the",
+        "surrogate stack).",
         f"{seeds_txt} seeds per cell.  Regenerate (one mode at a time is",
         "fine; aggregate rows persist in benchreport_rows.jsonl):",
-        "`python scripts/benchreport.py --seeds 30 [--modes tpu]",
+        "`python scripts/benchreport.py --seeds 30 [--modes surrogate]",
         "--state benchreport_state.jsonl --rows benchreport_rows.jsonl",
         "--out BENCHREPORT.md`.",
         "",
@@ -310,11 +327,12 @@ def to_markdown(rows, seeds):
             f"| {r['iqr'][0]:.0f}-{r['iqr'][1]:.0f} "
             f"| {r['censored']}/{r['seeds']} |")
         ratios.setdefault(r["problem"], {})[r["mode"]] = r["median_iters"]
-    lines += ["", "## Ratios (north star: tpu <= 50% of baseline)", ""]
+    lines += ["", "## Ratios (north star: surrogate <= 50% of baseline)",
+              ""]
     for prob, m in ratios.items():
-        if "baseline" in m and "tpu" in m and m["baseline"]:
-            ratio = m["tpu"] / m["baseline"]
-            lines.append(f"* **{prob}**: {m['tpu']:.0f} / "
+        if "baseline" in m and "surrogate" in m and m["baseline"]:
+            ratio = m["surrogate"] / m["baseline"]
+            lines.append(f"* **{prob}**: {m['surrogate']:.0f} / "
                          f"{m['baseline']:.0f} = **{ratio:.2f}**")
     lines.append("")
     return "\n".join(lines)
@@ -328,8 +346,10 @@ if __name__ == "__main__":
     ap.add_argument("--quick", action="store_true",
                     help="3 seeds, smaller budgets, rosenbrock-2d only")
     ap.add_argument("--problems", nargs="*", default=None)
-    ap.add_argument("--modes", nargs="*", default=["baseline", "tpu"],
-                    choices=["baseline", "tpu"])
+    ap.add_argument("--modes", nargs="*",
+                    default=["baseline", "surrogate"],
+                    choices=["baseline", "surrogate", "tpu"],
+                    help="'tpu' is the legacy name for 'surrogate'")
     ap.add_argument("--out", default=None, help="write markdown here")
     ap.add_argument("--state", default=None,
                     help="per-run checkpoint jsonl (resume after crash)")
@@ -339,6 +359,7 @@ if __name__ == "__main__":
                          "written back — lets one mode be re-measured "
                          "without redoing the other's sweep")
     args = ap.parse_args()
+    args.modes = sorted({_norm_mode(m) for m in args.modes})
     problems = args.problems or (
         ["rosenbrock-2d"] if args.quick else list(PROBLEMS))
     seeds = 3 if args.quick else args.seeds
@@ -350,6 +371,28 @@ if __name__ == "__main__":
         if os.path.exists(args.rows):
             with open(args.rows) as f:
                 prior = [json.loads(ln) for ln in f if ln.strip()]
+            for r in prior:
+                r["mode"] = _norm_mode(r["mode"])
+        if args.quick and any(
+                r["problem"] in PROBLEMS
+                and r.get("budget") == int(PROBLEMS[r["problem"]]()[3])
+                for r in prior):
+            # a --quick invocation must never displace full-budget rows
+            # from the published rows file: half-budget aggregates would
+            # silently become the source for the next --out regeneration.
+            # Divert this invocation's rows AND report to side files.
+            quick_rows = args.rows + ".quick"
+            print(f"rows: {args.rows} holds full-budget rows; --quick "
+                  f"results diverted to {quick_rows} (published rows and "
+                  f"--out untouched)", file=sys.stderr)
+            with open(quick_rows, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+            if args.out:
+                with open(args.out + ".quick", "w") as f:
+                    f.write(to_markdown(rows, seeds))
+                print(f"wrote {args.out}.quick", file=sys.stderr)
+            sys.exit(0)
         fresh = {(r["problem"], r["mode"]) for r in rows}
         scale = 0.5 if args.quick else 1.0
         kept, dropped = [], []

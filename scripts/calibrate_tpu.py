@@ -1,8 +1,8 @@
-"""Calibration grid for the tpu-mode surrogate settings in benchreport.
+"""Calibration grid for the surrogate-mode settings in benchreport.
 
 Runs a handful of seeds per (problem, variant) and prints median
-iters-to-threshold, so TPU_SOPTS choices are evidence-backed rather than
-guessed.  Variants are small dict overrides on top of TPU_SOPTS.
+iters-to-threshold, so SURROGATE_SOPTS choices are evidence-backed rather than
+guessed.  Variants are small dict overrides on top of SURROGATE_SOPTS.
 
 Usage: python scripts/calibrate_tpu.py [--seeds 6] [--problems ...]
 """
@@ -17,7 +17,7 @@ import cpuenv  # noqa: F401  (hang-proof platform)
 
 import numpy as np
 
-from benchreport import PROBLEMS, TPU_SOPTS, one_run
+from benchreport import PROBLEMS, SURROGATE_SOPTS, one_run
 
 VARIANTS = {
     "old": {"propose_batch": 0, "min_points": 32, "refit_interval": 32,
@@ -60,7 +60,7 @@ def main():
             # cached rows are only valid for the SAME effective settings
             # and budget (same staleness class benchreport._sopts_sig
             # guards against)
-            sig = json.dumps({**TPU_SOPTS, **VARIANTS[var],
+            sig = json.dumps({**SURROGATE_SOPTS, **VARIANTS[var],
                               "budget": budget}, sort_keys=True)
             iters = []
             for s in range(args.seeds):
@@ -69,7 +69,7 @@ def main():
                     iters.append(done[key]["iters"])
                     continue
                 t0 = time.time()
-                r = one_run(prob, "tpu", seed=1000 + s, budget=budget,
+                r = one_run(prob, "surrogate", seed=1000 + s, budget=budget,
                             sopts_override=VARIANTS[var])
                 import jax
                 jax.clear_caches()

@@ -249,12 +249,12 @@ class SurrogateManager:
             perms_loc = []
             for i, size in enumerate(space.perm_sizes):
                 base = jnp.tile(best_perms[i][None, :], (n_local, 1))
-                kp, k1, k2 = jax.random.split(kp, 3)
+                kp, k1, k2, k3 = jax.random.split(kp, 4)
                 mut = perm_ops.small_random_change_batch(
                     k1, base, 2.0 / max(size, 2))
                 shuf = perm_ops.shuffle_batch(jax.random.fold_in(k2, i),
                                               base)
-                coin = jax.random.uniform(k2, (n_local, 1)) < 0.75
+                coin = jax.random.uniform(k3, (n_local, 1)) < 0.75
                 perms_loc.append(
                     jnp.where(coin, mut, shuf).astype(jnp.int32))
             local = CandBatch(u_loc, tuple(perms_loc))
