@@ -3,7 +3,7 @@ on-device tuning engine.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-     "platform": "tpu"|"cpu"|"cpu:fallback", "quick": bool}
+     "platform": "tpu"|"cpu"|"cpu:fallback", "quick": bool, ...}
 
 `vs_baseline` is value / 100_000 — the north-star floor from
 BASELINE.json ("≥100k candidate acquisitions/sec on a v4-8"); the
@@ -16,52 +16,90 @@ propose (technique operator kernels) -> hash -> dedup vs a 2^15-entry
 history -> objective eval -> technique observe -> best update, all fused
 into one lax.scan program.
 
+Evidence + utilization: when the run lands on an accelerator, the raw
+measurement (per-rep wall times, device repr, XLA cost analysis,
+roofline utilization) is written to BENCH_TPU.json so the headline
+number is backed by a checked-in artifact rather than a claim.  The
+utilization story comes from XLA's own cost model for the compiled
+program (flops + bytes accessed per step): this engine is an
+elementwise/gather workload, so the roofline-relevant axis is HBM
+bandwidth, with MXU FLOP utilization reported for completeness.
+
 Backend selection is defensive: the TPU tunnel on this machine can be
-wedged (BENCH_r01 failed with "Unable to initialize backend 'axon'"), so
-we probe the backend with a bounded retry and fall back to CPU with an
-explicit `platform: "cpu"` label — a CPU number can never masquerade as
-the TPU number.  Pass --cpu to force the virtual CPU platform.
+wedged (BENCH_r01 rc=1; BENCH_r02 probe hung >90s twice), so the backend
+is probed in killable subprocesses with exponential backoff spanning
+minutes (budget via UT_BENCH_PROBE_BUDGET_S, default 240s) before
+falling back to CPU with an explicit `platform: "cpu:fallback"` label —
+a CPU number can never masquerade as the TPU number.  `--wait-for-tpu`
+extends the budget to hours for manual capture sessions; `--cpu` skips
+the probe and forces the virtual CPU platform.
 """
 import json
 import os
 import sys
 import time
 
+# published per-chip peaks for utilization estimates (upper bounds; the
+# bf16 MXU peak is quoted even though this engine runs f32, so flops
+# utilization is a conservative lower bound on achievable MFU)
+_PEAKS = {  # substring of device_kind -> (peak_flops/s, peak_hbm_B/s)
+    "v6": (918e12, 1640e9),
+    "v5p": (459e12, 2765e9),
+    "v5e": (197e12, 819e9),
+    "v5 lite": (197e12, 819e9),
+    "v4": (275e12, 1200e9),
+    "v3": (123e12, 900e9),
+    "v2": (45e12, 700e9),
+}
 
-def _probe_accelerator(timeout_s: float = 90.0) -> str:
-    """Check in a SUBPROCESS whether the accelerator backend initializes.
+
+def _probe_accelerator(budget_s: float) -> str:
+    """Check in SUBPROCESSES whether the accelerator backend initializes.
 
     A wedged TPU tunnel makes jax.devices() hang (not raise) — exactly
-    what killed BENCH_r01 — so the probe must be killable.  Returns the
-    platform name on success, '' on failure/timeout.
+    what killed BENCH_r01 — so each probe must be killable.  Retries
+    with exponential backoff until `budget_s` is spent (a transient
+    tunnel wedge should not cost the round its TPU number, VERDICT r2
+    next-step #1).  Returns the platform name on success, '' on
+    failure/timeout.
     """
     import subprocess
     code = ("import jax; d = jax.devices()[0]; "
             "print('UT_PLATFORM=' + d.platform)")
-    for attempt in range(2):
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    probe_timeout, sleep_s = 90.0, 5.0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        if attempt > 1 and remaining <= 10.0:
+            return ""  # always make at least one real attempt
+        tmo = max(10.0, min(probe_timeout, remaining))
         try:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
-                text=True, timeout=timeout_s)
+                text=True, timeout=tmo)
             for line in out.stdout.splitlines():
                 if line.startswith("UT_PLATFORM="):
                     plat = line.split("=", 1)[1].strip()
                     if plat and plat != "cpu":
                         return plat
-            print(f"bench: probe attempt {attempt + 1} got no accelerator "
+            print(f"bench: probe attempt {attempt} got no accelerator "
                   f"(rc={out.returncode}): {out.stderr.strip()[-300:]}",
                   file=sys.stderr)
         except subprocess.TimeoutExpired:
-            print(f"bench: probe attempt {attempt + 1} hung "
-                  f">{timeout_s:.0f}s (wedged TPU tunnel?)",
-                  file=sys.stderr)
-        time.sleep(2.0)
-    return ""
+            print(f"bench: probe attempt {attempt} hung "
+                  f">{tmo:.0f}s (wedged TPU "
+                  f"tunnel?), {max(0.0, deadline - time.monotonic()):.0f}s "
+                  f"of probe budget left", file=sys.stderr)
+        time.sleep(min(sleep_s, max(0.0, deadline - time.monotonic())))
+        sleep_s = min(sleep_s * 2, 120.0)
+        probe_timeout = min(probe_timeout * 2, 300.0)
 
 
-def _init_backend(cpu_flag: bool):
+def _init_backend(cpu_flag: bool, wait_for_tpu: bool):
     """Import jax and return (jax, platform_name).  Never hangs: the
-    accelerator is probed in a killable subprocess first; on failure we
+    accelerator is probed in killable subprocesses first; on failure we
     fall back to CPU with an explicit label."""
     from uptune_tpu.utils.platform_guard import force_cpu
 
@@ -70,7 +108,13 @@ def _init_backend(cpu_flag: bool):
         import jax
         return jax, "cpu"
 
-    plat = _probe_accelerator()
+    # default sized so probe + quick CPU fallback stays well inside the
+    # driver's bench step budget (commit e470740's concern): ~4 min of
+    # probing, then the fallback still produces its labeled JSON line
+    budget = float(os.environ.get("UT_BENCH_PROBE_BUDGET_S", "240"))
+    if wait_for_tpu:
+        budget = max(budget, 3 * 3600.0)
+    plat = _probe_accelerator(budget)
     if plat:
         import jax
         return jax, jax.devices()[0].platform
@@ -82,9 +126,41 @@ def _init_backend(cpu_flag: bool):
     return jax, "cpu:fallback"
 
 
+def _cost_analysis(compiled):
+    """XLA's cost model for the compiled program: (flops, bytes) or
+    (None, None) when the backend doesn't expose it."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):  # one entry per computation
+            ca = ca[0] if ca else {}
+        flops = ca.get("flops")
+        nbytes = ca.get("bytes accessed")
+        return (float(flops) if flops else None,
+                float(nbytes) if nbytes else None)
+    except Exception as e:  # pragma: no cover - backend-dependent
+        print(f"bench: cost_analysis unavailable: {e}", file=sys.stderr)
+        return None, None
+
+
+def _utilization(device_kind: str, flops_per_s, bytes_per_s):
+    """Roofline utilization vs published per-chip peaks (estimate)."""
+    kind = (device_kind or "").lower()
+    for sub, (pf, pb) in _PEAKS.items():
+        if sub in kind:
+            out = {"peak_flops_per_s": pf, "peak_hbm_bytes_per_s": pb}
+            if flops_per_s:
+                out["mxu_util"] = round(flops_per_s / pf, 6)
+            if bytes_per_s:
+                out["hbm_util"] = round(bytes_per_s / pb, 4)
+            return out
+    return {}
+
+
 def main() -> None:
     quick = "--quick" in sys.argv
-    jax, platform = _init_backend(cpu_flag="--cpu" in sys.argv)
+    jax, platform = _init_backend(
+        cpu_flag="--cpu" in sys.argv,
+        wait_for_tpu="--wait-for-tpu" in sys.argv)
     if platform == "cpu:fallback":
         # the fallback number is explicitly labeled and never stands in
         # for the TPU result; run it at quick size so a wedged tunnel
@@ -104,11 +180,14 @@ def main() -> None:
 
     steps = 20 if quick else 200
     state = eng.init(jax.random.PRNGKey(0))
-    run = jax.jit(lambda s: eng.run(s, steps))
-    state = run(state)                      # compile + warm
+    lowered = jax.jit(lambda s: eng.run(s, steps)).lower(state)
+    compiled = lowered.compile()
+    run = compiled
+    state = run(state)                      # warm (already compiled)
     jax.block_until_ready(state)
+    total_flops, total_bytes = _cost_analysis(compiled)
 
-    best_t = float("inf")
+    rep_times = []
     reps = 1 if quick else 3
     for _ in range(reps):
         s = eng.init(jax.random.PRNGKey(1))
@@ -116,18 +195,66 @@ def main() -> None:
         t0 = time.perf_counter()
         s = run(s)
         jax.block_until_ready(s)
-        best_t = min(best_t, time.perf_counter() - t0)
+        rep_times.append(time.perf_counter() - t0)
+    best_t = min(rep_times)
 
     acqs = steps * eng.total_batch
     rate = acqs / best_t
-    print(json.dumps({
+    result = {
         "metric": "candidate_acquisitions_per_sec_per_chip",
         "value": round(rate, 1),
         "unit": "configs/s",
         "vs_baseline": round(rate / 100_000.0, 3),
         "platform": platform,
         "quick": quick,
-    }))
+    }
+
+    dev = jax.devices()[0]
+    device_kind = getattr(dev, "device_kind", "?")
+    flops_per_s = total_flops / best_t if total_flops else None
+    bytes_per_s = total_bytes / best_t if total_bytes else None
+    util = _utilization(device_kind, flops_per_s, bytes_per_s)
+
+    if platform not in ("cpu", "cpu:fallback"):
+        if bytes_per_s:
+            result["hbm_gb_per_s"] = round(bytes_per_s / 1e9, 1)
+        if util.get("hbm_util") is not None:
+            result["hbm_util"] = util["hbm_util"]
+        # raw evidence artifact: the checked-in proof behind the README
+        # headline (VERDICT r2: a number the harness never reproduced is
+        # a claim, not a result)
+        artifact = {
+            **result,
+            "steps": steps,
+            "batch_per_step": eng.total_batch,
+            "acquisitions": acqs,
+            "rep_wall_s": [round(t, 4) for t in rep_times],
+            "devices": repr(jax.devices()),
+            "device_kind": device_kind,
+            "jax_version": jax.__version__,
+            "captured_unix": time.time(),
+            "cost_analysis": {
+                "total_flops": total_flops,
+                "total_bytes_accessed": total_bytes,
+                "flops_per_s": flops_per_s,
+                "bytes_per_s": bytes_per_s,
+                **util,
+                "note": ("XLA cost model over the whole compiled "
+                         "run(steps) program; peaks are published "
+                         "per-chip specs (bf16 MXU / HBM), so "
+                         "utilization values are estimates"),
+            },
+        }
+        # quick runs must not clobber a full evidence artifact: the
+        # README headline rests on the non-quick BENCH_TPU.json
+        name = "BENCH_TPU.quick.json" if quick else "BENCH_TPU.json"
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            name)
+        with open(path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"bench: raw evidence written to {path}", file=sys.stderr)
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

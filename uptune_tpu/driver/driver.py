@@ -404,27 +404,40 @@ class Tuner:
                                 float(self.best.qor))
         if cands is None:
             return None
-        tk = self._open_injected_ticket(cands, "surrogate")
+        pre = self._dedup_masked(cands)
+        if not pre[3].any():
+            # pool saturated around the incumbent: nothing novel, so no
+            # ticket is opened at all — no pull counted, no phantom
+            # zero-eval step — and the arms take this acquisition
+            # (ADVICE r2: the old path opened then abandoned the ticket,
+            # inflating arm_stats['surrogate'] pulls)
+            return None
+        tk = self._open_injected_ticket(cands, "surrogate", _pre=pre)
         if not tk.trials:
-            # pool saturated around the incumbent: serve + commit the
-            # all-dup ticket (mirrors inject()) so pending hashes clear
-            # and arm_stats pull counts stay truthful, then fall back to
-            # the arms for this acquisition
-            self._finalize(tk)
+            # every novel row was rejected by the user's config filter:
+            # the pull genuinely happened and produced 0 trials (counted
+            # as such); nothing is pending, so no finalize is needed
             return None
         return tk
 
-    def _open_injected_ticket(self, cands: CandBatch,
-                              source: str) -> _Ticket:
-        """Dedup -> pending-mask -> injected ticket -> open: the shared
-        plumbing behind inject() and the surrogate proposal plane.
-        Injected tickets never touch technique states or bandit credit."""
+    def _dedup_masked(self, cands: CandBatch):
+        """(hashes, known, src, novel_np): dedup vs history + in-batch,
+        then mask hashes already out for evaluation."""
         hashes, found, known, src, novel = self._dedup(
             self.hist_state, cands)
         novel_np, _ = self._mask_pending(hashes, novel)
-        tk = _Ticket(None, source, None, cands, hashes,
-                     np.asarray(known, np.float32).copy(),
-                     np.asarray(src), novel_np, injected=True, pruned=0)
+        return (hashes, np.asarray(known, np.float32).copy(),
+                np.asarray(src), novel_np)
+
+    def _open_injected_ticket(self, cands: CandBatch, source: str,
+                              _pre=None) -> _Ticket:
+        """Dedup -> pending-mask -> injected ticket -> open: the shared
+        plumbing behind inject() and the surrogate proposal plane.
+        Injected tickets never touch technique states or bandit credit."""
+        hashes, known, src, novel_np = (_pre if _pre is not None
+                                        else self._dedup_masked(cands))
+        tk = _Ticket(None, source, None, cands, hashes, known, src,
+                     novel_np, injected=True, pruned=0)
         self._open_ticket(tk)
         return tk
 
