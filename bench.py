@@ -68,6 +68,7 @@ def _probe_accelerator(budget_s: float) -> str:
             "print('UT_PLATFORM=' + d.platform)")
     deadline = time.monotonic() + budget_s
     attempt = 0
+    clean_cpu = 0
     probe_timeout, sleep_s = 90.0, 5.0
     while True:
         attempt += 1
@@ -79,11 +80,22 @@ def _probe_accelerator(budget_s: float) -> str:
             out = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 text=True, timeout=tmo)
+            answered = ""
             for line in out.stdout.splitlines():
                 if line.startswith("UT_PLATFORM="):
-                    plat = line.split("=", 1)[1].strip()
-                    if plat and plat != "cpu":
-                        return plat
+                    answered = line.split("=", 1)[1].strip()
+                    if answered and answered != "cpu":
+                        return answered
+            if out.returncode == 0 and answered == "cpu":
+                # a clean deterministic "cpu" answer means there is no
+                # accelerator on this machine at all — unlike a hang or
+                # crash (possibly-transient tunnel wedge), retrying for
+                # the whole budget would just stall a TPU-less box
+                clean_cpu += 1
+                if clean_cpu >= 2:
+                    print("bench: backend cleanly reports cpu-only twice; "
+                          "not retrying further", file=sys.stderr)
+                    return ""
             print(f"bench: probe attempt {attempt} got no accelerator "
                   f"(rc={out.returncode}): {out.stderr.strip()[-300:]}",
                   file=sys.stderr)

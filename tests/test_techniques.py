@@ -168,6 +168,89 @@ def test_portfolios_resolve():
     assert order1 != order2  # round robin rotates
 
 
+def test_registry_has_recycling_and_roundrobin():
+    names = tb.all_technique_names()
+    assert "RecyclingMetaTechnique" in names
+    assert "RoundRobinMetaSearchTechnique" in names
+    assert len(names) >= 44, len(names)
+
+
+def test_recycling_meta_restarts_fire_and_converge():
+    """The restart-meta recycles members whose window-best lags the global
+    best, and still descends on rosenbrock (metatechniques.py:89-180)."""
+    from uptune_tpu.driver.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_objective, rosenbrock_space
+
+    space = rosenbrock_space(2, -3.0, 3.0)
+    t = Tuner(space, rosenbrock_objective(2), seed=7,
+              technique="RecyclingMetaTechnique")
+    # shrink the window so recycling happens well within the budget
+    t.root.window = 4
+    res = t.run(test_limit=900)
+    assert t.root.restart_count > 0, "no member was ever recycled"
+    assert res.best_qor < 5.0, res.best_qor
+    # restarted members keep proposing (their state re-initialized, not
+    # removed): every member still has a live device state
+    assert set(t._tstates) >= {m.name for m in t.members}
+    t.close()
+
+
+def test_recycling_meta_spares_fresh_members():
+    """A member is only judged after completing a full window (the
+    reference's old_best_results guard)."""
+    from uptune_tpu.techniques.bandit import RecyclingMeta
+    from uptune_tpu.techniques.purerandom import PureRandom
+    m = RecyclingMeta([PureRandom(name="a"), PureRandom(name="b")],
+                      name="rm", window=2)
+    # first window: b is clearly worst, but has no previous window yet
+    m.credit("a", True, step_best=1.0, global_best=1.0)
+    m.credit("b", False, step_best=50.0, global_best=1.0)
+    assert m.poll_restart() == []
+    # second window: b lags the global best again -> restart queued
+    m.credit("a", False, step_best=2.0, global_best=1.0)
+    m.credit("b", False, step_best=60.0, global_best=1.0)
+    assert m.poll_restart() == ["b"]
+    assert m.restart_count == 1
+
+
+def test_restart_not_undone_by_stale_inflight_ticket():
+    """A ticket opened before a member restart must not write its
+    pre-restart state snapshot back when it finalizes later (async
+    ask/tell can hold several tickets for the same member in flight)."""
+    from uptune_tpu.driver.driver import Tuner
+    from uptune_tpu.techniques.bandit import RecyclingMeta
+    from uptune_tpu.techniques.purerandom import PureRandom
+    from uptune_tpu.workloads import rosenbrock_space
+
+    space = rosenbrock_space(2, -3.0, 3.0)
+    meta = RecyclingMeta([PureRandom(name="pr")], name="rm", window=1)
+    t = Tuner(space, technique=meta)
+    name = t.members[0].name
+
+    # round 1: establish a strong global best (prev window for 'pr')
+    for tr in t.ask(min_trials=1):
+        t.tell(tr, 0.0)
+    # two tickets in flight for the same member
+    batch_a = t.ask(min_trials=1)
+    batch_b = t.ask(min_trials=1)
+    # resolving A (worse than global best) triggers the recycle
+    for tr in batch_a:
+        t.tell(tr, 10.0)
+    assert t.root.restart_count >= 1
+    assert t._tgen[name] == t.root.restart_count
+    fresh = t._tstates[name]
+    # resolving stale B must NOT overwrite the re-initialized state
+    for tr in batch_b:
+        t.tell(tr, 20.0)
+    restarts_after_b = t.root.restart_count
+    if t._tgen[name] == restarts_after_b:
+        # B itself may trigger another recycle (window=1); only when no
+        # newer restart superseded it can we check the guard directly
+        assert t._tstates[name] is fresh, \
+            "stale ticket reverted the restart"
+    t.close()
+
+
 def test_permutation_space_only():
     """Techniques that support pure-permutation spaces handle them; tsp-like
     objective improves under GA/PSO."""

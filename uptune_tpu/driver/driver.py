@@ -46,6 +46,10 @@ class StepStats(NamedTuple):
     best_qor: float
     was_new_best: bool
     pruned: int = 0
+    # cumulative live history rows evicted past capacity (oldest-first,
+    # history.py insert): nonzero means dedup no longer sees the oldest
+    # part of the run
+    hist_dropped: int = 0
 
 
 class Trial:
@@ -78,10 +82,10 @@ class _Ticket:
 
     __slots__ = ("arm", "arm_name", "tstate", "cands", "hashes", "known",
                  "src", "novel_np", "injected", "pruned", "trials",
-                 "remaining", "u_np", "perms_np")
+                 "remaining", "u_np", "perms_np", "gen")
 
     def __init__(self, arm, arm_name, tstate, cands, hashes, known, src,
-                 novel_np, injected, pruned):
+                 novel_np, injected, pruned, gen=0):
         self.arm = arm
         self.arm_name = arm_name
         self.tstate = tstate
@@ -96,6 +100,11 @@ class _Ticket:
         self.remaining = 0
         self.u_np = None
         self.perms_np = None
+        # member-state generation at open time: a restart bumps the
+        # member's generation, and stale tickets (opened before the
+        # restart) must not write observe(tk.tstate) back over the
+        # freshly re-initialized state
+        self.gen = gen
 
 
 class TuneResult(NamedTuple):
@@ -208,6 +217,10 @@ class Tuner:
         self._tstates: Dict[str, Any] = {}
         self._propose_jit: Dict[str, Any] = {}
         self._observe_jit: Dict[str, Any] = {}
+        self._member_by_name: Dict[str, Technique] = {
+            t.name: t for t in self.members}
+        # bumped on each RecyclingMeta restart; see _Ticket.gen
+        self._tgen: Dict[str, int] = {t.name: 0 for t in self.members}
         for t in self.members:
             self.key, k = jax.random.split(self.key)
             self._tstates[t.name] = t.init_state(space, k)
@@ -514,7 +527,8 @@ class Tuner:
         name = "random" if injected else t.name
         tk = _Ticket(t, name, tstate, cands, hashes,
                      np.asarray(known, np.float32).copy(), np.asarray(src),
-                     novel_np, injected, pruned)
+                     novel_np, injected, pruned,
+                     gen=self._tgen.get(t.name, 0))
         self._open_ticket(tk)
         return tk
 
@@ -656,24 +670,47 @@ class Tuner:
         self.evals += evaluated
 
         if not tk.injected:
-            self._tstates[tk.arm.name] = self._observe_jit[tk.arm.name](
-                tk.tstate, tk.cands, qor, self.best)
+            if tk.gen == self._tgen.get(tk.arm.name, 0):
+                self._tstates[tk.arm.name] = self._observe_jit[
+                    tk.arm.name](tk.tstate, tk.cands, qor, self.best)
+            # else: the member was restarted while this ticket was in
+            # flight — observing would write the pre-restart snapshot
+            # back over the fresh state, silently undoing the restart
             if isinstance(self.root, MetaTechnique):
-                self.root.credit(tk.arm.name, was_new_best)
+                # window-best from the ticket's LIVE trials only: the
+                # batch qor also carries history-dup rows served their
+                # recorded result, which would let a member that only
+                # re-proposes known configs inherit the incumbent's QoR
+                # and dodge recycling
+                step_best = min((tr.qor for tr in live),
+                                default=float("inf"))
+                self.root.credit(tk.arm.name, was_new_best,
+                                 step_best=step_best, global_best=new)
+                # quality-aware metas (RecyclingMeta) may ask for member
+                # restarts: re-initialize the member's device state (the
+                # jitted programs are keyed by name and stay cached)
+                for nm in self.root.poll_restart():
+                    t = self._member_by_name.get(nm)
+                    if t is not None:
+                        self.key, k = jax.random.split(self.key)
+                        self._tstates[nm] = t.init_state(self.space, k)
+                        self._tgen[nm] = self._tgen.get(nm, 0) + 1
         if was_new_best:
             self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])[2] += 1
-        if self.evals > self.history.capacity and not self._cap_warned:
+        dropped = int(self.hist_state.dropped)
+        if dropped and not self._cap_warned:
             self._cap_warned = True
             import warnings
             warnings.warn(
-                f"evaluation count ({self.evals}) exceeded history capacity "
-                f"({self.history.capacity}); dedup will degrade — raise "
-                f"Tuner(capacity=...)")
+                f"history capacity ({self.history.capacity}) exceeded; "
+                f"oldest entries are being evicted (dedup no longer sees "
+                f"the start of the run) — raise Tuner(capacity=...); "
+                f"running drop count is in StepStats.hist_dropped")
         self.steps += 1
         self._flush_archive()
         stats = StepStats(self.steps, tk.arm_name, tk.cands.batch,
                           evaluated, self.sign * new, was_new_best,
-                          tk.pruned)
+                          tk.pruned, dropped)
         if self.hooks:
             if was_new_best:
                 res = self.result()
