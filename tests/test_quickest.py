@@ -126,6 +126,178 @@ class TestRegressions:
         assert x.shape == (2, 1)
 
 
+class TestAnalyze:
+    """The analysis stage (reference quickest/analyze.py:149-498)."""
+
+    @pytest.fixture(scope="class")
+    def fitted(self):
+        x, y = _dataset()
+        names = [f"feat{i}" for i in range(x.shape[1])]
+        est = QuickEst(mlp_steps=150).fit(
+            x, y, ["LUT_impl", "FF_impl"], feature_names=names)
+        return est, _dataset(1)
+
+    def test_scores_table(self, fitted, tmp_path):
+        from uptune_tpu.quickest import scores
+        est, (xt, yt) = fitted
+        out = scores(est, xt, yt, ["LUT_impl", "FF_impl"],
+                     save_dir=str(tmp_path))
+        assert out["LUT_impl"]["R2"] > 0.8
+        assert 0.0 <= out["LUT_impl"]["RRSE"] < 0.6
+        assert (tmp_path / "scores.csv").exists()
+
+    def test_feature_importance_finds_signal(self, fitted, tmp_path):
+        from uptune_tpu.quickest import feature_importance
+        est, _ = fitted
+        imp = feature_importance(est, save_dir=str(tmp_path))
+        lut = imp["LUT_impl"]
+        ranked = [f for f in lut if f != "__selected__"]
+        # feat0 (weight 3.0) must rank above every noise feature
+        assert ranked.index("feat0") < 5
+        assert "feat0" in lut["__selected__"]
+        assert (tmp_path / "feature_importance.csv").exists()
+
+    def test_learning_curve_improves_with_data(self, tmp_path):
+        from uptune_tpu.quickest import learning_curve
+        x, y = _dataset(n=240)
+        xt, yt = _dataset(1, n=120)
+        out = learning_curve(x, y[:, 0], xt, yt[:, 0], ["LUT_impl"],
+                             points=3, mlp_steps=120,
+                             save_dir=str(tmp_path))
+        d = out["LUT_impl"]
+        assert len(d["nums"]) == 3 and d["nums"][-1] == 240
+        # more data must not make the held-out fit dramatically worse,
+        # and the full-data model must genuinely fit (RRSE < 0.7)
+        assert d["test"][-1] < max(d["test"][0] * 1.5, 0.7)
+        assert (tmp_path / "learning_curve.csv").exists()
+
+    def test_hls_scores_direct_baseline(self):
+        from uptune_tpu.quickest import hls_scores
+        rng = np.random.RandomState(0)
+        early = rng.rand(50, 2).astype(np.float32) * 100
+        impl = np.stack([early[:, 0] * 1.1 + 3,
+                         rng.rand(50) * 100], 1).astype(np.float32)
+        out = hls_scores(early, impl, [("Registers", "Registers_used")],
+                         ["Registers", "DSP"],
+                         ["Registers_used", "DSP_used"])
+        assert out["Registers_used"]["R2"] > 0.9
+
+    def test_analyze_dispatch(self, fitted):
+        import uptune_tpu as ut
+        est, (xt, yt) = fitted
+        out = ut.analyze("sc", est=est, x=xt, y=yt,
+                         target_names=["LUT_impl", "FF_impl"])
+        assert "LUT_impl" in out
+        with pytest.raises(ValueError, match="unknown analysis"):
+            ut.analyze("nope")
+
+
+class TestExtract:
+    """LegUp-shaped HLS report scraping (funcs.py:270-447)."""
+
+    @staticmethod
+    def _make_tree(root, design="fir", cp=10, with_fit=True):
+        d = root / design / f"{design}CP_{cp}"
+        d.mkdir(parents=True)
+        (d / "scheduling.legup.rpt").write_text(
+            "Some header\nClock period constraint: 10.00ns\n")
+        (d / "resources.legup.rpt").write_text(
+            "Logic Elements: 1200\n"
+            "Combinational: 800\n"
+            "Registers: 450\n"
+            "DSP Elements: 6\n"
+            'Operation "signed_add_32" x 14\n'
+            'Operation "signed_multiply_32" x 3\n')
+        (d / "timingReport.legup.rpt").write_text(
+            "-----------------Delay of path:5.10 ns-----\n"
+            "-----------------Delay of path:7.90 ns-----\n"
+            "-----------------Delay of path:6.00 ns-----\n")
+        (d / "top.v").write_text(
+            "// Number of RAM elements: 4\nmodule top(); endmodule\n")
+        if with_fit:
+            (d / "top.fit.rpt").write_text(
+                "; Total registers : ; 512 ;\n"
+                "; Total block memory bits ; 2,048 / 4,096 ;\n"
+                "; Total RAM Blocks ; 2 / 8 ;\n"
+                "; Total DSP Blocks ; 6 / 112 ;\n"
+                "; Combinational ALUT usage for logic ; 900 ;\n"
+                "; Combinational ALUT usage for route-throughs ; 30 ;\n"
+                "; Memory ALUT usage ; 12 ;\n")
+        return d
+
+    def test_scrape_and_extract(self, tmp_path):
+        from uptune_tpu.quickest import extract as q_extract
+        from uptune_tpu.quickest.hlsreport import TARGETS
+        self._make_tree(tmp_path, "fir", 10)
+        self._make_tree(tmp_path, "matmul", 20)
+        out = tmp_path / "feats.csv"
+        n = q_extract([str(tmp_path / "fir"), str(tmp_path / "matmul")],
+                      str(out))
+        assert n == 2
+        x, y, fn, tn = load_csv(str(out), TARGETS)
+        assert tn == TARGETS
+        # early features present with the scraped values
+        row = dict(zip(fn, x[0]))
+        assert row["Registers"] == 450
+        assert row["Clock Period"] == pytest.approx(10.0)
+        assert row["Delay_of_path_max"] == pytest.approx(7.9)
+        assert row["Delay_of_path_med"] == pytest.approx(6.0)
+        assert row["RAM Elements"] == 4
+        assert row["signed_add_32"] == 14
+        # targets scraped from the fit report (ALUT = 900+30+12)
+        ty = dict(zip(tn, y[0]))
+        assert ty["Registers_used"] == 512
+        assert ty["ALUT_used"] == 942
+        assert ty["Block_memory_bits_used"] == 2048
+
+    def test_rows_without_fit_report_skipped(self, tmp_path):
+        from uptune_tpu.quickest import extract as q_extract
+        self._make_tree(tmp_path, "a", 1, with_fit=True)
+        self._make_tree(tmp_path, "b", 2, with_fit=False)
+        out = tmp_path / "feats.csv"
+        n = q_extract([str(tmp_path / "a"), str(tmp_path / "b")],
+                      str(out))
+        assert n == 1  # funcs.py:438-439 skips unimplemented rows
+        n2 = q_extract([str(tmp_path / "a"), str(tmp_path / "b")],
+                       str(out), require_targets=False)
+        assert n2 == 2
+
+    def test_discover_operations(self, tmp_path):
+        from uptune_tpu.quickest import discover_operations
+        self._make_tree(tmp_path, "fir", 3)
+        ops = discover_operations([str(tmp_path / "fir")])
+        assert ops == ["signed_add_32", "signed_multiply_32"]
+
+    def test_extract_to_train_round_trip(self, tmp_path):
+        """End-to-end: report tree -> CSV -> ut.train -> predict."""
+        from uptune_tpu.quickest import extract as q_extract
+        from uptune_tpu.quickest.hlsreport import TARGETS
+        rng = np.random.RandomState(0)
+        dirs = []
+        for i in range(24):
+            regs = int(rng.randint(100, 2000))
+            d = tmp_path / f"d{i}" / f"d{i}CP_{i}"
+            d.mkdir(parents=True)
+            (d / "resources.legup.rpt").write_text(
+                f"Registers: {regs}\nLogic Elements: {regs * 3}\n")
+            # implementation register count tracks the HLS estimate
+            (d / "top.fit.rpt").write_text(
+                f"; Total registers : ; {int(regs * 1.2) + 7} ;\n"
+                "; Total DSP Blocks ; 1 / 112 ;\n")
+            dirs.append(str(tmp_path / f"d{i}"))
+        out = tmp_path / "f.csv"
+        two = ["Registers_used", "DSP_blocks_used"]
+        assert q_extract(dirs, str(out), targets=two) == 24
+        x, y, fn, tn = load_csv(str(out), two)
+        # drop the non-numeric path column via preprocess-side NaN impute
+        est = QuickEst(mlp_steps=100, top_k=4).fit(
+            x, y[:, tn.index("Registers_used")], ["Registers_used"],
+            feature_names=fn)
+        pred = est.predict(x[:5], "Registers_used")
+        assert np.abs(pred - y[:5, tn.index("Registers_used")]).mean() \
+            < 250
+
+
 class TestCSV:
     def test_load_csv(self, tmp_path):
         p = tmp_path / "d.csv"

@@ -45,6 +45,10 @@ _LAZY = {
     "train": ("uptune_tpu.quickest", "train"),
     "test": ("uptune_tpu.quickest", "test"),
     "predict": ("uptune_tpu.quickest", "predict"),
+    # QuickEst analysis + HLS-report extraction (reference
+    # quickest/analyze.py:498, quickest/extract/LegUp/funcs.py:270-447)
+    "analyze": ("uptune_tpu.quickest", "analyze"),
+    "extract": ("uptune_tpu.quickest", "extract"),
 }
 
 

@@ -12,8 +12,13 @@ selection -> MLP ensemble (uptune_tpu.surrogate.mlp) on the selected
 features -> a stacked combination of the linear and MLP heads fit on a
 validation split — all jitted, persisted as npz+json.
 """
+from .analyze import (analyze, feature_importance, hls_scores,
+                      learning_curve, rrse, scores)
+from .hlsreport import discover_operations, extract, scrape_checkpoint
 from .pipeline import (QuickEst, load_csv, predict, preprocess, test,
                        train)
 
 __all__ = ["QuickEst", "preprocess", "train", "test", "predict",
-           "load_csv"]
+           "load_csv", "analyze", "scores", "hls_scores",
+           "learning_curve", "feature_importance", "rrse",
+           "extract", "discover_operations", "scrape_checkpoint"]

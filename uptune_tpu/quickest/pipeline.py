@@ -60,8 +60,14 @@ def preprocess(x: np.ndarray, *, impute: bool = True,
     — apply the same meta to inference-time features via
     `apply_preprocess`."""
     x = np.asarray(x, np.float32).copy()
-    med = np.nanmedian(np.where(np.isfinite(x), x, np.nan), axis=0)
-    med = np.where(np.isfinite(med), med, 0.0)
+    finite = np.isfinite(x)
+    # column-safe median: all-NaN columns (e.g. a text path column from
+    # an extractor CSV) impute to 0 without numpy's All-NaN warning
+    med = np.zeros(x.shape[1], np.float32)
+    for j in range(x.shape[1]):
+        col = x[finite[:, j], j]
+        if col.size:
+            med[j] = np.median(col)
     if impute:
         bad = ~np.isfinite(x)
         x[bad] = np.broadcast_to(med, x.shape)[bad]
@@ -314,9 +320,13 @@ _DEFAULT_DIR = "quickest_models"
 
 def train(x: np.ndarray, y: np.ndarray, target_names: Sequence[str],
           save_dir: Optional[str] = _DEFAULT_DIR,
+          feature_names: Optional[Sequence[str]] = None,
           **model_opts) -> QuickEst:
-    """Train + persist (the reference's `train()` CLI, train.py:500)."""
-    est = QuickEst(**model_opts).fit(x, y, target_names)
+    """Train + persist (the reference's `train()` CLI, train.py:500).
+    Pass `feature_names` so downstream feature-importance reports name
+    real features instead of positional f{i} placeholders."""
+    est = QuickEst(**model_opts).fit(x, y, target_names,
+                                     feature_names=feature_names)
     if save_dir:
         est.save(save_dir)
     return est

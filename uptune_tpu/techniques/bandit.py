@@ -215,13 +215,21 @@ class RecyclingMeta(RoundRobinMeta):
         # duplicates / failures) is legitimately worst — that is the
         # stagnated case the restart-meta exists for.
         pulled = [k for k, p in self._win_pulls.items() if p > 0]
+        restarted = None
         if pulled:
             worst = max(pulled, key=lambda k: self._win_best[k])
             if (self._prev_pulls.get(worst, 0) > 0
                     and self._global < self._win_best[worst]):
                 self._queued.append(worst)
                 self.restart_count += 1
+                restarted = worst
         self._prev_pulls = dict(self._win_pulls)
+        if restarted is not None:
+            # the re-seeded member gets one full window of grace before
+            # it can be judged again (the reference's replacement starts
+            # with old_best_results=None); without this a lagging member
+            # would churn through a restart every single window
+            self._prev_pulls[restarted] = 0
         self._win_best = {k: float("inf") for k in self._win_best}
         self._win_pulls = {k: 0 for k in self._win_pulls}
 
