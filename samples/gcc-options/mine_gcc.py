@@ -1,0 +1,219 @@
+"""Mine the installed g++'s real optimization space.
+
+The reference mines its gcc space at tune time (/root/reference/samples/
+gcc-options/tune_gcc.py:100-163): `-f...` flags from `--help=optimizers`
+validity-checked one by one, numeric `--param`s with defaults parsed out
+of gcc's params.def source.  Modern gcc (>= 10) prints every param's
+range and default directly (`g++ -Q --help=params` lines like
+`--param=asan-globals=<0,1>  1`), so this miner needs no compiler source
+tree: flags come from --help=optimizers, params from -Q --help=params
+(only those with an explicit <min,max> range and an integer default),
+and each surviving option is proven to compile a trivial program before
+it enters the space.
+
+Results are cached as JSON next to this file keyed by `g++ --version`,
+so the one-time ~1-2 min validity sweep is shared by every evaluation
+sandbox (the worker pool symlink-farms the sample dir; realpath lands
+here).  Flags are tuned as on/off/default tri-states exactly like the
+reference (tune_gcc.py:189-197 cfg_to_flags: on -> -fX, off -> -fno-X,
+default -> omitted).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+_DIR = os.path.dirname(os.path.realpath(__file__))
+_CACHE = os.path.join(_DIR, ".gcc_space_cache.json")
+
+_PARAM_LINE = re.compile(
+    r"^\s+--param=([a-zA-Z0-9-]+)=<(-?\d+),(\d+)>\s+(-?\d+)\s*$")
+_FLAG_LINE = re.compile(r"^  (-f[a-z0-9-]+) ", re.MULTILINE)
+
+
+def _cc_version(cc: str = "g++") -> str:
+    out = subprocess.run([cc, "--version"], capture_output=True,
+                         text=True, timeout=30)
+    return out.stdout.splitlines()[0].strip() if out.stdout else "unknown"
+
+
+def _flag_works(cc: str, opts: List[str]) -> bool:
+    """True when `cc -O2 <opts>` compiles a trivial program cleanly
+    (tune_gcc.py:60-74 check_if_flag_works)."""
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "t.cpp")
+        with open(src, "w") as f:
+            f.write("int main() { return 0; }\n")
+        try:
+            r = subprocess.run(
+                [cc, "-O2", *opts, src, "-o", os.path.join(d, "t.bin")],
+                capture_output=True, timeout=60)
+        except subprocess.TimeoutExpired:
+            return False
+    return r.returncode == 0
+
+
+def mine(cc: str = "g++", use_cache: bool = True,
+         max_flags: Optional[int] = None,
+         max_params: Optional[int] = None) -> Dict[str, object]:
+    """-> {'version', 'flags': [...], 'params': {name: [lo, hi, dflt]}}"""
+    version = _cc_version(cc)
+    if use_cache and os.path.exists(_CACHE):
+        try:
+            with open(_CACHE) as f:
+                cached = json.load(f)
+            if cached.get("version") == version:
+                return cached
+        except (json.JSONDecodeError, OSError):
+            pass
+
+    out = subprocess.run([cc, "--help=optimizers"], capture_output=True,
+                         text=True, timeout=60)
+    candidates = sorted(set(_FLAG_LINE.findall(out.stdout)))
+    if max_flags:
+        candidates = candidates[:max_flags]
+    flags = [fl for fl in candidates if _flag_works(cc, [fl])
+             and _flag_works(cc, [_off(fl)])]
+
+    out = subprocess.run([cc, "-Q", "--help=params"], capture_output=True,
+                         text=True, timeout=60)
+    params: Dict[str, Tuple[int, int, int]] = {}
+    for line in out.stdout.splitlines():
+        m = _PARAM_LINE.match(line)
+        if not m:
+            continue
+        name, lo, hi, dflt = (m.group(1), int(m.group(2)),
+                              int(m.group(3)), int(m.group(4)))
+        if lo >= hi:
+            continue
+        dflt = min(max(dflt, lo), hi)
+        params[name] = (lo, hi, dflt)
+    if max_params:
+        params = dict(sorted(params.items())[:max_params])
+    params = {n: v for n, v in params.items()
+              if _flag_works(cc, [f"--param={n}={v[2]}"])}
+
+    mined = {"version": version, "flags": flags,
+             "params": {n: list(v) for n, v in params.items()}}
+    if use_cache:
+        tmp = _CACHE + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(mined, f)
+        os.replace(tmp, _CACHE)   # atomic vs concurrent sandboxes
+    return mined
+
+
+def _off(flag: str) -> str:
+    return "-fno-" + flag[2:]
+
+
+def build_and_time(cc_args: List[str], src: str,
+                   expected: Optional[bytes] = None, runs: int = 3,
+                   cc: str = "g++", compile_timeout: float = 120.0,
+                   run_timeout: float = 60.0) -> float:
+    """Compile `src` with `cc_args`, run it `runs` times, return the
+    best wall time — or +inf on compile failure, crash, timeout, or
+    (when `expected` is given) output that differs from the anchor.
+
+    The output gate is load-bearing: without it the tuner 'wins' with
+    ABI-breaking miscompiles (observed: -fpack-struct makes the qsort
+    payload print 0 in 3.5ms instead of its checksum in 385ms).  Shared
+    by the `ut` sample and the benchreport gcc-real problem so the gate
+    semantics can't drift apart."""
+    import math
+    import time as _time
+
+    exe = tempfile.NamedTemporaryFile(suffix=".bin", delete=False).name
+    try:
+        try:
+            r = subprocess.run([cc, *cc_args, src, "-o", exe],
+                               capture_output=True,
+                               timeout=compile_timeout)
+        except subprocess.TimeoutExpired:
+            return math.inf
+        if r.returncode != 0:
+            return math.inf
+        best = math.inf
+        for _ in range(runs):
+            t0 = _time.perf_counter()
+            try:
+                out = subprocess.run([exe], capture_output=True,
+                                     timeout=run_timeout, check=True)
+            except (subprocess.TimeoutExpired,
+                    subprocess.CalledProcessError, OSError):
+                return math.inf
+            best = min(best, _time.perf_counter() - t0)
+            if expected is not None and out.stdout != expected:
+                return math.inf
+        return best
+    finally:
+        if os.path.exists(exe):
+            os.unlink(exe)
+
+
+def anchor_output(src: str, extra: List[str] = (), cc: str = "g++",
+                  use_cache: bool = True) -> bytes:
+    """Reference stdout of a plain -O2 build of `src` — the output every
+    tuned build must reproduce.  Cached next to this file keyed by a
+    digest of (compiler version, payload source), so editing the payload
+    or switching compilers invalidates the cache instead of silently
+    failing every trial against a stale checksum."""
+    import hashlib
+
+    with open(src, "rb") as f:
+        payload = f.read()
+    digest = hashlib.sha256(
+        _cc_version(cc).encode() + b"\0" + payload).hexdigest()[:12]
+    stem = os.path.splitext(os.path.basename(src))[0]
+    cache = os.path.join(_DIR, f".anchor_{stem}_{digest}.bin")
+    if use_cache and os.path.exists(cache):
+        with open(cache, "rb") as f:
+            return f.read()
+    with tempfile.TemporaryDirectory() as d:
+        exe = os.path.join(d, "anchor.bin")
+        subprocess.run([cc, "-O2", *extra, src, "-o", exe],
+                       capture_output=True, timeout=120, check=True)
+        out = subprocess.run([exe], capture_output=True, timeout=60,
+                             check=True).stdout
+    if use_cache:
+        tmp = cache + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(out)
+        os.replace(tmp, cache)
+    return out
+
+
+def config_to_cmd(cfg: Dict[str, object], mined: Dict[str, object]
+                  ) -> List[str]:
+    """Config dict -> g++ argument list (cfg_to_flags,
+    tune_gcc.py:180-197)."""
+    args = [str(cfg["olevel"])]
+    for fl in mined["flags"]:
+        v = cfg.get(fl, "default")
+        if v == "on":
+            args.append(fl)
+        elif v == "off":
+            args.append(_off(fl))
+    for name in mined["params"]:
+        if name in cfg:
+            args.append(f"--param={name}={int(cfg[name])}")
+    return args
+
+
+def build_space(mined: Dict[str, object]):
+    """Mined description -> uptune_tpu Space (for library-mode use, e.g.
+    the benchreport real-gcc row; the `ut` CLI sample declares the same
+    space via ut.tune calls instead)."""
+    from uptune_tpu.space.params import EnumParam, IntParam
+    from uptune_tpu.space.spec import Space
+
+    specs = [EnumParam("olevel", ("-O0", "-O1", "-O2", "-O3"))]
+    for fl in mined["flags"]:
+        specs.append(EnumParam(fl, ("default", "on", "off")))
+    for name, (lo, hi, _d) in sorted(mined["params"].items()):
+        specs.append(IntParam(name, int(lo), int(hi)))
+    return Space(specs)

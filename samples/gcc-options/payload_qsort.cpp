@@ -1,0 +1,37 @@
+// Second gcc-options payload: sorting + integer arithmetic, the same
+// role as the reference's extra gcc-options apps (tsp_ga, raytracer —
+// /root/reference/samples/gcc-options/src/) but self-contained and
+// seconds-scale.  Deterministic (fixed LCG seed); prints a checksum so
+// the optimizer cannot dead-code the work away.
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+static inline uint32_t lcg(uint32_t &s) {
+    s = s * 1664525u + 1013904223u;
+    return s;
+}
+
+int main() {
+    uint32_t seed = 12345u;
+    uint64_t checksum = 0;
+    for (int round = 0; round < 24; ++round) {
+        std::vector<uint32_t> v(120000);
+        for (auto &x : v) x = lcg(seed);
+        std::sort(v.begin(), v.end());
+        // branchy binary-search workload over the sorted data
+        for (int q = 0; q < 60000; ++q) {
+            uint32_t key = lcg(seed);
+            auto it = std::lower_bound(v.begin(), v.end(), key);
+            if (it != v.end()) checksum += *it >> 7;
+        }
+        // integer kernel with data-dependent flow
+        for (size_t i = 1; i + 1 < v.size(); i += 3) {
+            uint32_t a = v[i - 1], b = v[i], c = v[i + 1];
+            checksum += (a > b ? a - b : b - a) ^ (c * 2654435761u >> 5);
+        }
+    }
+    std::printf("%llu\n", (unsigned long long)checksum);
+    return 0;
+}

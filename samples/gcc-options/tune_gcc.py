@@ -1,50 +1,57 @@
-"""Tune real g++ flags + block size on a blocked matmul — the shape of
-the reference's gcc-options sample (/root/reference/samples/gcc-options/
-tune_gcc.py: -O level, on/off optimizer flags, numeric params) on the
-tutorial's mmm_block payload, small enough to run anywhere g++ exists.
+"""Tune the REAL mined g++ optimization space on a real payload — the
+reference's flagship workload (/root/reference/samples/gcc-options/
+tune_gcc.py): -O level, every working `-f` optimizer flag as an
+on/off/default tri-state, and every ranged numeric `--param`, mined from
+the installed compiler by mine_gcc (first run sweeps flag validity for
+~30s, then cached).  ~330 parameters on g++ 12.
 
-    ut samples/gcc-options/tune_gcc.py -pf 2 --test-limit 30 \
-        --runtime-limit 60
+    ut samples/gcc-options/tune_gcc.py -pf 4 --test-limit 60 \
+        --runtime-limit 120
 
-QoR = best-of-3 wall time of the compiled binary (seconds); failed
-compiles report +inf and count as failures.
+Payload selection (UT_GCC_PAYLOAD): `mmm` (default) = the tutorial's
+blocked matmul, with BLOCK_SIZE tuned alongside the compiler space;
+`qsort` = sort/arithmetic benchmark.  QoR = best-of-3 wall time of the
+compiled binary (seconds); failed compiles report +inf.
 """
 import math
 import os
-import subprocess
-import tempfile
-import time
+import sys
 
-import uptune_tpu as ut
+sys.path.insert(0, os.path.dirname(os.path.realpath(__file__)))
+import mine_gcc  # noqa: E402
+
+import uptune_tpu as ut  # noqa: E402
+
+MINED = mine_gcc.mine()
 
 olevel = ut.tune("-O2", ["-O0", "-O1", "-O2", "-O3"], name="olevel")
-FLAGS = ("-funroll-loops", "-ftree-vectorize", "-ffast-math",
-         "-fomit-frame-pointer", "-finline-functions")
-enabled = [ut.tune(False, name=f) for f in FLAGS]
-block = ut.tune(16, (4, 128), name="block_size")
+cfg = {"olevel": olevel}
+for fl in MINED["flags"]:
+    cfg[fl] = ut.tune("default", ["default", "on", "off"], name=fl)
+for name, (lo, hi, dflt) in sorted(MINED["params"].items()):
+    cfg[name] = ut.tune(int(dflt), (int(lo), int(hi)), name=name)
 
-src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "mmm_block.cpp")
-exe = tempfile.NamedTemporaryFile(suffix=".bin", delete=False).name
-cmd = (["g++", olevel, f"-DBLOCK_SIZE={block}"]
-       + [f for f, on in zip(FLAGS, enabled) if on]
-       + [src, "-o", exe])
+here = os.path.dirname(os.path.realpath(__file__))
+payload = os.environ.get("UT_GCC_PAYLOAD", "mmm")
+if payload == "mmm":
+    src = os.path.join(here, "mmm_block.cpp")
+    block = ut.tune(16, (4, 128), name="block_size")
+    extra = [f"-DBLOCK_SIZE={block}"]
+else:
+    src = os.path.join(here, "payload_qsort.cpp")
+    extra = []
 
-try:
-    cc = subprocess.run(cmd, capture_output=True, timeout=120)
-    if cc.returncode != 0:
-        ut.target(math.inf, "min")      # compile failure
-    else:
-        best = math.inf
-        for _ in range(3):
-            t0 = time.perf_counter()
-            subprocess.run([exe], capture_output=True, timeout=60,
-                           check=True)
-            best = min(best, time.perf_counter() - t0)
-        ut.target(best, "min")
-        print(f"{olevel} block={block} "
-              f"flags={[f for f, on in zip(FLAGS, enabled) if on]} "
-              f"t={best:.4f}s")
-finally:
-    if os.path.exists(exe):
-        os.unlink(exe)
+# correctness gate: a tuned config only counts if the payload still
+# prints the -O2 anchor's output (ABI-breaking flag combos -- e.g.
+# -fpack-struct on libstdc++ code -- otherwise "win" by miscompiling);
+# the anchor is cached keyed by (compiler version, payload source) so a
+# payload edit invalidates it instead of failing every trial
+want = mine_gcc.anchor_output(src, extra)
+best = mine_gcc.build_and_time(
+    [*mine_gcc.config_to_cmd(cfg, MINED), *extra], src, expected=want)
+ut.target(best, "min")
+if math.isfinite(best):
+    n_on = sum(1 for fl in MINED["flags"] if cfg[fl] != "default")
+    print(f"{olevel} touched_flags={n_on} t={best:.4f}s")
+else:
+    print(f"{olevel} FAILED (compile error, crash, or wrong output)")

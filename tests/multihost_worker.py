@@ -1,0 +1,75 @@
+"""Worker for the REAL multi-process DCN test (run via subprocess by
+tests/test_multihost.py::TestTwoProcess).
+
+Each of the 2 processes owns 2 virtual CPU devices; jax.distributed
+wires them through the coordination service exactly as real multi-host
+TPU pods do over DCN (SURVEY §4: "multi-host tests via JAX multi-process
+simulation on CPU").  The worker builds the hybrid ('search','eval')
+mesh — eval inside the host, search spanning hosts — runs sharded-engine
+steps, and prints the global best it computed so the parent can assert
+both processes agree.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# JAX_PLATFORMS=cpu alone is NOT enough on this machine: the axon
+# TPU-tunnel backend factory dials out during backends() init and hangs
+# when the tunnel is wedged — drop it like tests/conftest.py does
+from uptune_tpu.utils.platform_guard import force_cpu  # noqa: E402
+
+force_cpu(2)
+
+
+def main() -> int:
+    from uptune_tpu.parallel import (initialize, is_coordinator,
+                                     make_multihost_mesh)
+    cfg = initialize()           # from UT_COORDINATOR / UT_* env
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    assert jax.process_count() == cfg["num_processes"], (
+        jax.process_count(), cfg)
+    assert jax.local_device_count() == 2
+    n_global = len(jax.devices())
+    assert n_global == 2 * cfg["num_processes"]
+
+    mesh = make_multihost_mesh(n_eval_per_host=2)
+    assert dict(mesh.shape) == {"search": cfg["num_processes"],
+                                "eval": 2}, dict(mesh.shape)
+
+    from uptune_tpu.engine import FusedEngine, default_arms
+    from uptune_tpu.parallel.sharded import ShardedEngine
+    from uptune_tpu.workloads import rosenbrock_space, sphere_device
+
+    space = rosenbrock_space(4, -3.0, 3.0)
+    eng = FusedEngine(space, lambda v, p: sphere_device(v),
+                      arms=default_arms(1), history_capacity=1 << 10)
+    se = ShardedEngine(eng, mesh)
+    state = se.init(jax.random.PRNGKey(0))
+    state = se.run(state, 25)
+    jax.block_until_ready(state)
+
+    # per-replica bests live sharded across hosts: allgather to every
+    # process, then each computes the same global answer
+    qors = multihost_utils.process_allgather(state.best.qor, tiled=True)
+    qors = np.asarray(qors).reshape(-1)
+    gbest = float(qors.min())
+    # every replica already holds the exchanged global best (the
+    # per-step _exchange collective), so all replica bests must agree
+    spread = float(qors.max() - qors.min())
+    print(f"UT_MH pid={cfg['process_id']} coord={is_coordinator()} "
+          f"replicas={qors.shape[0]} global_best={gbest:.9f} "
+          f"spread={spread:.3e}", flush=True)
+    assert spread < 1e-6, f"replicas disagree after exchange: {qors}"
+    assert gbest < 1.0, f"sharded engine failed to descend: {gbest}"
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,16 @@
-"""Multi-host bootstrap helpers.  Real DCN needs multiple processes;
-here we verify the config resolution/validation layer and the mesh
-layout contract on the virtual 8-device CPU platform (full sharded
-execution is covered by tests/test_engine.py and the driver's
-dryrun_multichip)."""
+"""Multi-host bootstrap + REAL multi-process DCN tests.
+
+TestConfig/TestMesh verify the config-resolution layer and the mesh
+layout contract on the virtual 8-device CPU platform.  TestTwoProcess
+(SURVEY §4's multi-host requirement; VERDICT r2 next-step #4) spawns two
+actual `jax.distributed` processes, builds the hybrid mesh, runs sharded
+engine steps with the cross-host best-exchange collective, and asserts
+both processes computed the same global best."""
+import os
+import socket
+import subprocess
+import sys
+
 import pytest
 
 jax = pytest.importorskip("jax")
@@ -43,6 +51,59 @@ class TestConfig:
             distributed_config("h:1", 2, 5)
         with pytest.raises(ValueError, match=">= 1"):
             distributed_config(num_processes=0)
+
+
+@pytest.mark.slow
+class TestTwoProcess:
+    def test_distributed_best_exchange(self, tmp_path):
+        """2 jax.distributed CPU processes × 2 devices: initialize() for
+        real, hybrid mesh, 25 sharded steps, identical global best."""
+        port = _free_port()
+        env_base = {k: v for k, v in os.environ.items()
+                    if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        worker = os.path.join(os.path.dirname(__file__),
+                              "multihost_worker.py")
+        procs = []
+        for pid in range(2):
+            env = dict(
+                env_base,
+                JAX_PLATFORMS="cpu",
+                UT_COORDINATOR=f"localhost:{port}",
+                UT_NUM_PROCESSES="2",
+                UT_PROCESS_ID=str(pid),
+            )
+            procs.append(subprocess.Popen(
+                [sys.executable, worker], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                pytest.fail("multihost worker hung")
+            assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+            outs.append(out)
+        bests = []
+        for out in outs:
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith("UT_MH "))
+            bests.append(line.split("global_best=")[1].split()[0])
+        # both processes computed the identical global best
+        assert bests[0] == bests[1], outs
+        # exactly one coordinator
+        coords = [("coord=True" in o) for o in outs]
+        assert sorted(coords) == [False, True], outs
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 class TestMesh:

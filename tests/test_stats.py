@@ -12,9 +12,9 @@ jax = pytest.importorskip("jax")
 from uptune_tpu.driver.driver import Tuner  # noqa: E402
 from uptune_tpu.space.params import FloatParam  # noqa: E402
 from uptune_tpu.space.spec import Space  # noqa: E402
-from uptune_tpu.utils.stats import (convergence, load_archive, main,  # noqa: E402
-                                    render_table, technique_report,
-                                    write_csv)
+from uptune_tpu.utils.stats import (ArchiveTail, convergence, follow,  # noqa: E402
+                                    load_archive, main, render_table,
+                                    technique_report, write_csv)
 
 
 @pytest.fixture(scope="module")
@@ -103,3 +103,55 @@ class TestConvergenceAndOutputs:
         p = tmp_path / "empty.jsonl"
         p.write_text("")
         assert main([str(p)]) == 1
+
+
+class TestFollow:
+    """The during-run live view (reference: the decouple dashboard,
+    async_task_scheduler.py:148-209)."""
+
+    @staticmethod
+    def _row(i, tech="t", qor=1.0, best=False):
+        return json.dumps({"gid": i, "tech": tech, "time": 0.01,
+                           "cfg": {}, "u": [], "perms": [],
+                           "qor": qor, "best": best}) + "\n"
+
+    def test_tail_reads_incrementally(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        p.write_text(json.dumps({"space_sig": "x"}) + "\n"
+                     + self._row(0, qor=5.0, best=True))
+        tail = ArchiveTail(str(p))
+        first = tail.read_new()
+        assert len(first) == 1            # header filtered
+        assert tail.read_new() == []      # no growth -> no rows
+        with open(p, "a") as f:
+            f.write(self._row(1, tech="u", qor=3.0, best=True))
+        second = tail.read_new()
+        assert len(second) == 1 and second[0]["tech"] == "u"
+
+    def test_tail_buffers_partial_lines(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        full = self._row(0, qor=2.0)
+        p.write_text(full[:10])           # writer mid-line
+        tail = ArchiveTail(str(p))
+        assert tail.read_new() == []
+        with open(p, "a") as f:
+            f.write(full[10:])
+        assert len(tail.read_new()) == 1
+
+    def test_tail_resets_on_rotation(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        p.write_text(self._row(0) + self._row(1))
+        tail = ArchiveTail(str(p))
+        assert len(tail.read_new()) == 2
+        p.write_text(self._row(7, tech="fresh"))   # shrank: rotated
+        rows = tail.read_new()
+        assert len(rows) == 1 and rows[0]["tech"] == "fresh"
+
+    def test_follow_renders_live_view(self, tmp_path, capsys):
+        p = tmp_path / "a.jsonl"
+        p.write_text(self._row(0, tech="DE", qor=4.0, best=True)
+                     + self._row(1, tech="DE", qor=9.0))
+        rc = follow(str(p), interval=0.01, max_polls=3)
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "evals=2" in out and "best=4" in out and "DE" in out

@@ -30,7 +30,11 @@ import jax.numpy as jnp
 
 from ..space.spec import CandBatch, Space
 
-_SENTINEL = jnp.uint32(0xFFFFFFFF)
+# plain int, cast at use sites: a module-level jnp scalar would create a
+# device array at import time and initialize the XLA backend, which
+# breaks jax.distributed.initialize() in multi-process runs (it must run
+# before any backend init)
+_SENTINEL = 0xFFFFFFFF
 # max number of equal-h0 neighbours scanned on lookup; h0 collisions of
 # distinct configs are ~n^2/2^33 over a run, so 8 is far beyond need
 _WINDOW = 8
@@ -65,7 +69,8 @@ class History:
 
     @staticmethod
     def _clamp(hashes: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        h0 = jnp.minimum(hashes[:, 0].astype(jnp.uint32), _SENTINEL - 1)
+        h0 = jnp.minimum(hashes[:, 0].astype(jnp.uint32),
+                         jnp.uint32(_SENTINEL - 1))
         h1 = hashes[:, 1].astype(jnp.uint32)
         return h0, h1
 
@@ -93,8 +98,8 @@ class History:
         (empty slots before any live row); the count of evicted live
         rows accumulates in `dropped`."""
         h0n, h1n = self._clamp(hashes)
-        h0n = jnp.where(valid, h0n, _SENTINEL)
-        h1n = jnp.where(valid, h1n, _SENTINEL)
+        h0n = jnp.where(valid, h0n, jnp.uint32(_SENTINEL))
+        h1n = jnp.where(valid, h1n, jnp.uint32(_SENTINEL))
         age_n = jnp.where(valid, st.step, -1).astype(jnp.int32)
         h0c = jnp.concatenate([st.h0, h0n])
         h1c = jnp.concatenate([st.h1, h1n])
@@ -109,8 +114,8 @@ class History:
             (key, h0c, h1c, qc, ac), num_keys=1)
         h0k, h1k, qk, ak = h0k[:cap], h1k[:cap], qk[:cap], ak[:cap]
         # evicted rows must not survive as hash-matchable ghosts
-        h0k = jnp.where(ak >= 0, h0k, _SENTINEL)
-        h1k = jnp.where(ak >= 0, h1k, _SENTINEL)
+        h0k = jnp.where(ak >= 0, h0k, jnp.uint32(_SENTINEL))
+        h1k = jnp.where(ak >= 0, h1k, jnp.uint32(_SENTINEL))
         # phase 2: restore the sorted-hash invariant contains() needs
         h0s, h1s, qs, ags = jax.lax.sort((h0k, h1k, qk, ak), num_keys=2)
         total = st.n + valid.sum().astype(jnp.int32)

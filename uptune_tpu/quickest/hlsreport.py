@@ -99,9 +99,11 @@ def scrape_checkpoint(path: str,
                 for name in ("Logic Elements", "Combinational",
                              "Registers", "DSP Elements"):
                     if name in line:
-                        m = re.search(r": (.+)$", line)
+                        # first number only: real report lines carry
+                        # trailing text ('Registers: 450 / 114480 (12%)')
+                        m = re.search(r": ([0-9,]+)", line)
                         if m:
-                            rec[name] = _to_int(m.group(1).strip())
+                            rec[name] = _to_int(m.group(1))
                 m = re.search(r'Operation "(.+)" x ([0-9,]+)', line)
                 if m and m.group(1) in rec:
                     rec[m.group(1)] = _to_int(m.group(2))
@@ -128,14 +130,18 @@ def scrape_checkpoint(path: str,
                 "Delay_of_path_max", "Delay_of_path_min",
                 "Delay_of_path_mean", "Delay_of_path_med")})
 
-    for fn in os.listdir(path):
-        if os.path.splitext(fn)[1] == ".v":
-            with open(os.path.join(path, fn), errors="replace") as f:
-                for line in f:
-                    m = re.search(
-                        r"// Number of RAM elements: ([0-9,]+)", line)
-                    if m:
-                        rec["RAM Elements"] = _to_int(m.group(1))
+    # first match across the (sorted, deterministic) .v files wins;
+    # generated netlists can be MBs, so stop at the first hit
+    for fn in sorted(os.listdir(path)):
+        if os.path.splitext(fn)[1] != ".v" or "RAM Elements" in rec:
+            continue
+        with open(os.path.join(path, fn), errors="replace") as f:
+            for line in f:
+                m = re.search(
+                    r"// Number of RAM elements: ([0-9,]+)", line)
+                if m:
+                    rec["RAM Elements"] = _to_int(m.group(1))
+                    break
 
     p = os.path.join(path, "top.fit.rpt")
     if os.path.exists(p):

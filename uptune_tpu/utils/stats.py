@@ -10,13 +10,22 @@ same attribution (`tech` per row, driver/driver.py _log_trial), so the
 whole analysis is one pass over the file.
 
 CLI:  ut-stats ut.archive.jsonl [--csv out.csv] [--plot out.png]
+      ut-stats ut.archive.jsonl --follow     # live during-run view
+
+`--follow` replaces the reference's decouple-mode runtime matplotlib
+dashboard (src/async_task_scheduler.py:148-209 blitting QoR curves): it
+tails the archive as the controller appends trials and re-renders
+best-so-far + per-technique attribution in place, working over ssh where
+a GUI dashboard cannot.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import math
+import os
 import sys
+import time
 from typing import Any, Dict, List, Optional
 
 Row = Dict[str, Any]
@@ -161,6 +170,97 @@ def plot(rows: List[Row], path: str, sense: str = "min") -> bool:
     return True
 
 
+class ArchiveTail:
+    """Incremental archive reader for --follow: returns newly appended
+    complete rows per poll, surviving slow writers (partial trailing
+    lines are buffered, not dropped) and archive rotation (the driver
+    rotates a space-mismatched archive on resume — detected by the file
+    shrinking, which resets the cursor)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.partial = b""
+
+    def read_new(self) -> List[Row]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.offset:            # rotated/truncated: start over
+            self.offset = 0
+            self.partial = b""
+        if size == self.offset:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            chunk = f.read()
+            self.offset = f.tell()
+        data = self.partial + chunk
+        lines = data.split(b"\n")
+        self.partial = lines.pop()        # b"" when chunk ended in \n
+        rows: List[Row] = []
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if "space_sig" not in rec:
+                rows.append(rec)
+        return rows
+
+
+def _render_follow(rows: List[Row], sense: str, started: float) -> str:
+    sign = 1.0 if sense == "min" else -1.0
+    finite = [sign * float(r["qor"]) for r in rows
+              if math.isfinite(float(r["qor"]))]
+    best = sign * min(finite) if finite else None
+    last_best_i = max((i for i, r in enumerate(rows) if r.get("best")),
+                      default=None)
+    head = [
+        f"ut-stats --follow   evals={len(rows)} "
+        f"failures={len(rows) - len(finite)} "
+        f"best={'-' if best is None else f'{best:.6g}'} "
+        f"last_improvement=@{'-' if last_best_i is None else last_best_i} "
+        f"uptime={time.time() - started:.0f}s",
+        "",
+    ]
+    return "\n".join(head) + render_table(technique_report(rows, sense))
+
+
+def follow(path: str, sense: str = "min", interval: float = 2.0,
+           max_polls: Optional[int] = None) -> int:
+    """Tail the archive and re-render the live view every `interval`
+    seconds until interrupted (`max_polls` bounds the loop for tests)."""
+    tail = ArchiveTail(path)
+    rows: List[Row] = []
+    started = time.time()
+    polls = 0
+    dirty = True
+    try:
+        while max_polls is None or polls < max_polls:
+            polls += 1
+            new = tail.read_new()
+            if new:
+                rows.extend(new)
+                dirty = True
+            if dirty:
+                view = _render_follow(rows, sense, started)
+                if sys.stdout.isatty():
+                    sys.stdout.write("\x1b[2J\x1b[H" + view + "\n")
+                else:
+                    sys.stdout.write(view + "\n")
+                sys.stdout.flush()
+                dirty = False
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ut-stats",
@@ -172,7 +272,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--plot", help="write convergence plot PNG")
     ap.add_argument("--json", action="store_true",
                     help="print the report as JSON")
+    ap.add_argument("--follow", action="store_true",
+                    help="live during-run view: tail the archive and "
+                         "re-render best-so-far + attribution")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll interval in seconds")
     args = ap.parse_args(argv)
+    if args.follow:
+        return follow(args.archive, args.sense, args.interval)
     rows = load_archive(args.archive)
     if not rows:
         print("ut-stats: empty archive", file=sys.stderr)
