@@ -1,0 +1,64 @@
+"""Intel FPGA OpenCL (AOCL) compile tuning — the reference's
+intel-aocl sample (/root/reference/samples/intel-aocl/tune_aocl.py:
+fitter seed + QSF assignments appended to the generated top.qsf, aoc
+recompile, kernel fmax parsed from the Quartus report).
+
+Runs against `mock_flow.py` (deterministic acl_quartus_report.txt in
+the real format) by default; set UT_AOCL_FLOW to a wrapper script that
+runs `aoc` + Quartus for real builds.  QoR = kernel fmax, maximized.
+
+    ut samples/intel-aocl/tune_aocl.py -pf 2 --test-limit 30
+"""
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+import uptune_tpu as ut
+
+HERE = os.path.dirname(os.path.realpath(__file__))
+DESIGN = "gemm"
+
+option = {
+    "seed": ut.tune(1, (1, 100), name="seed"),
+    "optimization_technique":
+        ut.tune("Balanced", ["Area", "Speed", "Balanced"]),
+    "fitter_effort": ut.tune("Auto Fit", ["Standard Fit", "Auto Fit"]),
+    "physical_synthesis": ut.tune("Off", ["On", "Off"]),
+    "mux_restructure": ut.tune("Auto", ["On", "Off", "Auto"]),
+    "fmax_target": ut.tune(240, (200, 400), name="fmax_target"),
+}
+
+workdir = tempfile.mkdtemp(prefix="ut_aocl_")
+# QSF assignments appended to the HLS-generated project, like the
+# reference's config() writes into top.qsf / afu_opencl_kernel.qsf
+with open(os.path.join(workdir, "top.qsf"), "w") as f:
+    f.write(f"set_global_assignment -name SEED {option['seed']}\n")
+    for k in ("optimization_technique", "fitter_effort",
+              "physical_synthesis", "mux_restructure"):
+        f.write(f'set_global_assignment -name "{k}" "{option[k]}"\n')
+
+flow = os.environ.get("UT_AOCL_FLOW")
+if flow:
+    subprocess.run([flow, workdir, json.dumps(option)], check=False,
+                   timeout=float(os.environ.get("UT_AOCL_TIMEOUT",
+                                                20 * 3600)))
+else:
+    subprocess.run([sys.executable, os.path.join(HERE, "mock_flow.py"),
+                    workdir, json.dumps(option)], check=True, timeout=600)
+
+rpt = os.path.join(workdir, DESIGN, "acl_quartus_report.txt")
+fmax = None
+if os.path.isfile(rpt):
+    import re
+    with open(rpt) as f:
+        m = re.search(r"Kernel fmax: (\d+\.?\d*)", f.read())
+    if m:
+        fmax = float(m.group(1))
+if fmax is None:
+    ut.target(-math.inf, "max")
+else:
+    ut.target(fmax, "max")
+    print(f"seed={option['seed']} fmax={fmax:.1f}MHz")

@@ -1,0 +1,63 @@
+"""Mock PetaBricks autotunable binary: the interface shape of a real
+PetaBricks program (config exemplar + `--config=<file>` runs printing a
+timing element) with a deterministic algorithmic-choice cost model —
+sort with cutoff-switched algorithms, a blocking knob, and a selector
+between strategies, the canonical PetaBricks tutorial knobs.
+
+  mock_program.py --print-config          # exemplar: name kind spec...
+  mock_program.py --config=cfg.json -n N  # prints <timing time="S"/>
+"""
+import json
+import math
+import sys
+
+KNOBS = [
+    # name, kind, spec
+    ("sort_cutoff", "log_int", {"lo": 1, "hi": 4096, "default": 64}),
+    ("block_size", "log_int", {"lo": 1, "hi": 512, "default": 8}),
+    ("parallel_split", "int", {"lo": 1, "hi": 16, "default": 2}),
+    ("strategy", "selector",
+     {"choices": ["insertion", "quick", "merge", "radix"],
+      "default": "quick"}),
+    ("use_prefetch", "switch", {"n": 2, "default": 0}),
+]
+
+
+def cost(cfg: dict, n: int) -> float:
+    """Deterministic runtime model with a real optimum: radix+large
+    blocks wins at big n, insertion+small cutoff at small n."""
+    cutoff = int(cfg["sort_cutoff"])
+    block = int(cfg["block_size"])
+    split = int(cfg["parallel_split"])
+    strat = cfg["strategy"]
+    pref = int(cfg["use_prefetch"])
+
+    base = {"insertion": 0.004 * n * max(1, n / max(cutoff, 1)) * 1e-3,
+            "quick": 1.4e-6 * n * math.log2(max(n, 2)),
+            "merge": 1.6e-6 * n * math.log2(max(n, 2)),
+            "radix": 9e-6 * n}[strat]
+    base *= 1.0 + 0.35 * abs(math.log2(block) - 5) / 5
+    base *= 1.0 + 0.2 * abs(split - 8) / 8
+    base *= 0.92 if pref else 1.0
+    # mis-set cutoff hurts the recursive strategies
+    if strat in ("quick", "merge"):
+        base *= 1.0 + 0.3 * abs(math.log2(max(cutoff, 1)) - 6) / 6
+    return base
+
+
+def main() -> int:
+    if "--print-config" in sys.argv:
+        for name, kind, spec in KNOBS:
+            print(json.dumps({"name": name, "kind": kind, **spec}))
+        return 0
+    cfg_path = next(a.split("=", 1)[1] for a in sys.argv
+                    if a.startswith("--config="))
+    n = int(sys.argv[sys.argv.index("-n") + 1])
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    print(f'<timing time="{cost(cfg, n):.6f}"/>')
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

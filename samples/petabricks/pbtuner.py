@@ -1,0 +1,121 @@
+"""PetaBricks autotuner bridge — the reference's petabricks sample
+(/root/reference/samples/petabricks/pbtuner.py): read a program's
+config exemplar, build the search space (Integer/LogInteger/Switch/
+Selector parameters), tune by running `program --config=<file> -n N`
+and parsing the `<timing time=.../>` output, write the best config.
+
+Library-mode (ask/tell) rather than `ut` CLI, like the reference uses
+the OpenTuner MeasurementInterface directly.  Works out of the box
+against mock_program.py; point it at any binary speaking the same
+protocol.
+
+    python samples/petabricks/pbtuner.py [program] [-n 100000]
+        [--test-limit 120] [--output best_cfg.json]
+"""
+import argparse
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+_TIMING = re.compile(r'<timing\s+time="([0-9.eE+-]+)"')
+
+
+def build_space(exemplar_lines):
+    from uptune_tpu.space.params import (IntParam, LogIntParam,
+                                         SelectorParam, SwitchParam)
+    from uptune_tpu.space.spec import Space
+
+    specs = []
+    for line in exemplar_lines:
+        k = json.loads(line)
+        if k["kind"] == "int":
+            specs.append(IntParam(k["name"], k["lo"], k["hi"]))
+        elif k["kind"] == "log_int":
+            specs.append(LogIntParam(k["name"], k["lo"], k["hi"]))
+        elif k["kind"] == "switch":
+            specs.append(SwitchParam(k["name"], k["n"]))
+        elif k["kind"] == "selector":
+            specs.append(SelectorParam(k["name"],
+                                       choices=tuple(k["choices"])))
+        else:
+            raise ValueError(f"unknown knob kind {k['kind']!r}")
+    return Space(specs)
+
+
+def run_once(program, cfg: dict, n: int, timeout: float) -> float:
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump(cfg, f)
+        path = f.name
+    try:
+        out = subprocess.run(
+            [*program, f"--config={path}", "-n", str(n)],
+            capture_output=True, text=True, timeout=timeout)
+        if out.returncode != 0:
+            return math.inf
+        m = _TIMING.search(out.stdout)
+        return float(m.group(1)) if m else math.inf
+    except subprocess.TimeoutExpired:
+        return math.inf
+    finally:
+        os.unlink(path)
+
+
+def decode(space, cfg: dict) -> dict:
+    """Normalize selector values to choices (Space.to_configs already
+    decodes positions to choices; raw positions appear only if a caller
+    hands this function an encoded config)."""
+    from uptune_tpu.space.params import SelectorParam
+    out = dict(cfg)
+    for spec in space.specs:
+        if isinstance(spec, SelectorParam):
+            v = cfg[spec.name]
+            out[spec.name] = (v if v in spec.choices
+                              else spec.choice_of(v))
+    return out
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.abspath(__file__))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("program", nargs="*",
+                    default=[sys.executable,
+                             os.path.join(here, "mock_program.py")])
+    ap.add_argument("-n", type=int, default=100000)
+    ap.add_argument("--test-limit", type=int, default=120)
+    ap.add_argument("--run-timeout", type=float, default=30.0)
+    ap.add_argument("--output", default="best_cfg.json")
+    args = ap.parse_args()
+    program = args.program
+
+    exemplar = subprocess.run(
+        [*program, "--print-config"], capture_output=True, text=True,
+        timeout=60, check=True).stdout.splitlines()
+    space = build_space([ln for ln in exemplar if ln.strip()])
+
+    from uptune_tpu.driver.driver import Tuner
+
+    def objective(cfgs):
+        return [run_once(program, decode(space, c), args.n,
+                         args.run_timeout) for c in cfgs]
+
+    t = Tuner(space, objective, seed=0)
+    res = t.run(test_limit=args.test_limit)
+    t.close()
+    best = decode(space, res.best_config)
+    with open(args.output, "w") as f:
+        json.dump(best, f, indent=1)
+    print(json.dumps({"best_config": best, "best_time": res.best_qor,
+                      "evals": res.evals}))
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Deterministic mock Quartus fit for the systolic-array sample:
+emits Systolic_Array_8x8.sta.fit.summary in the real STA summary text
+format the sample (and the reference, systolic-array/quartus.py:29-41)
+parses.  Slack responds to effort/physical-synthesis options and
+per-seed luck, like a real seed sweep."""
+import hashlib
+import json
+import os
+import sys
+
+
+def run(workdir: str, opts: dict) -> None:
+    seed = int(opts.get("seed", 1))
+    luck_bytes = hashlib.sha256(
+        json.dumps(opts, sort_keys=True).encode()).digest()
+    luck = int.from_bytes(luck_bytes[:4], "big") / 2 ** 32
+    seed_luck = ((seed * 2654435761) % 997) / 997.0
+
+    slack = -0.9
+    slack += {"Speed": 0.5, "Balanced": 0.25, "Area": 0.0}[
+        opts["optimization_technique"]]
+    slack += 0.3 if opts["physical_synthesis"] == "On" else 0.0
+    slack += 0.2 if opts["fitter_effort"] == "Standard Fit" else 0.0
+    slack += 0.15 if opts["synth_timing_driven_synthesis"] == "On" else 0
+    slack += -0.2 if opts["synthesis_effort"] == "Fast" else 0.0
+    slack += 0.35 * seed_luck + 0.1 * luck
+    tns = min(0.0, slack) * 85.0
+
+    with open(os.path.join(workdir,
+                           "Systolic_Array_8x8.sta.fit.summary"),
+              "w") as f:
+        f.write("Type  : setup\n")
+        f.write(f"Slack : {slack:.3f}\n")
+        f.write(f"TNS : {tns:.1f}\n")
+
+
+if __name__ == "__main__":
+    run(sys.argv[1], json.loads(sys.argv[2]))

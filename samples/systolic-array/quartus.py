@@ -1,0 +1,75 @@
+"""Systolic-array Quartus option + seed sweep — the reference's
+systolic-array sample (/root/reference/samples/systolic-array/
+quartus.py: 10 global-assignment options written as options.tcl,
+quartus_sh run, slack/TNS parsed out of the
+Systolic_Array_8x8.sta.*.summary report).
+
+Runs against `mock_flow.py` (deterministic, real STA summary format) by
+default; set UT_QUARTUS_FLOW to a `flow workdir optsjson` wrapper for
+real Quartus Pro.  QoR = -slack (maximize positive slack).
+
+    ut samples/systolic-array/quartus.py -pf 2 --test-limit 30
+"""
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+import uptune_tpu as ut
+
+HERE = os.path.dirname(os.path.realpath(__file__))
+DESIGN = "Systolic_Array_8x8"
+
+option = {
+    "auto_dsp_recognition": ut.tune("On", ["On", "Off"]),
+    "disable_register_merging_across_hierarchies":
+        ut.tune("Auto", ["On", "Off", "Auto"]),
+    "mux_restructure": ut.tune("Auto", ["On", "Off", "Auto"]),
+    "optimization_technique":
+        ut.tune("Balanced", ["Area", "Speed", "Balanced"]),
+    "synthesis_effort": ut.tune("Auto", ["Auto", "Fast"]),
+    "synth_timing_driven_synthesis": ut.tune("On", ["On", "Off"]),
+    "fitter_aggressive_routability_optimization":
+        ut.tune("Automatically", ["Always", "Automatically", "Never"]),
+    "fitter_effort": ut.tune("Auto Fit", ["Standard Fit", "Auto Fit"]),
+    "remove_duplicate_registers": ut.tune("On", ["On", "Off"]),
+    "physical_synthesis": ut.tune("Off", ["On", "Off"]),
+    "seed": ut.tune(1, (1, 64), name="seed"),
+}
+
+workdir = tempfile.mkdtemp(prefix="ut_systolic_")
+# options.tcl exactly as the reference writes it
+with open(os.path.join(workdir, "options.tcl"), "w") as f:
+    for k, v in option.items():
+        if k == "seed":
+            f.write(f'set_global_assignment -name SEED {v}\n')
+        else:
+            f.write(f'set_global_assignment -name "{k}" "{v}"\n')
+
+flow = os.environ.get("UT_QUARTUS_FLOW")
+if flow:
+    subprocess.run([flow, workdir, json.dumps(option)], check=False,
+                   timeout=float(os.environ.get("UT_QUARTUS_TIMEOUT",
+                                                7200)))
+else:
+    subprocess.run([sys.executable, os.path.join(HERE, "mock_flow.py"),
+                    workdir, json.dumps(option)], check=True, timeout=600)
+
+
+# slack/TNS via the library extractor (api/features.py get_timing,
+# exported through ut.quartus): handles 'None' entries and partial
+# summaries instead of crashing the trial
+from uptune_tpu.api.features import get_timing  # noqa: E402
+
+try:
+    slack, tns = get_timing(DESIGN, workdir, "fit")
+except OSError:
+    slack = None
+if slack is None:
+    ut.target(math.inf, "min")
+else:
+    ut.target(-float(slack), "min")   # maximize slack
+    print(f"seed={option['seed']} slack={float(slack):.3f} "
+          f"tns={float(tns):.1f}")

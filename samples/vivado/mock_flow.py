@@ -1,0 +1,125 @@
+"""Deterministic mock Vitis/Vivado implementation flow.
+
+Consumes the config.ini files tune_vitis.py writes, emits (a) the
+post-route timing summary report in the real Xilinx text layout the
+reference parses (WNS/TNS six lines under "Design Timing Summary",
+/root/reference/samples/vivado/tune_vitis.py:126-139) and (b) a Vivado
+HLS csynth XML in the schema `ut.vhls` scrapes — so the whole
+option -> flow -> report -> extract -> QoR loop runs without licensed
+tools.  Point tune_vitis.py's UT_VITIS_FLOW at a real run.sh wrapper to
+drive actual builds.
+
+QoR model: WNS degrades with target frequency and improves with
+Explore-style directives, enabled phys-opt passes, and per-option
+deterministic "luck" — the tradeoff shape of the real implementation
+steps (UG904).
+"""
+import hashlib
+import json
+import os
+import sys
+
+
+def _luck(opts: dict, salt: str) -> float:
+    h = hashlib.sha256(
+        (salt + json.dumps(opts, sort_keys=True)).encode()).digest()
+    return int.from_bytes(h[:4], "big") / 2 ** 32
+
+
+DIRECTIVE_GAIN = {
+    "Explore": 0.30, "AggressiveExplore": 0.38, "ExploreArea": 0.22,
+    "ExploreWithRemap": 0.26, "ExploreWithHoldFix": 0.28,
+    "ExploreWithAggressiveHoldFix": 0.27, "AddRemap": 0.15,
+    "AddRetime": 0.18, "AlternateReplication": 0.16,
+    "AggressiveFanoutOpt": 0.2, "AlternateFlowWithRetiming": 0.24,
+    "ExploreSequentialArea": 0.12, "WLDrivenBlockPlacement": 0.2,
+    "ExtraNetDelay_high": 0.24, "ExtraNetDelay_low": 0.18,
+    "ExtraPostPlacementOpt": 0.26, "ExtraTimingOpt": 0.3,
+    "NoTimingRelaxation": 0.22, "MoreGlobalIterations": 0.25,
+    "HigherDelayCost": 0.2, "Default": 0.0, "Disabled": -0.1,
+    "RuntimeOptimized": -0.15, "Quick": -0.25, "NoBramPowerOpt": 0.05,
+}
+
+
+def run(workdir: str, opts: dict) -> None:
+    freq = float(opts.get("Frequency", 300))
+    target_period = 1000.0 / freq
+
+    gain = 0.0
+    for key, val in opts.items():
+        if key.endswith("ARGS.DIRECTIVE"):
+            stage_enabled = opts.get(
+                key.split(".ARGS")[0] + ".IS_ENABLED", "true") == "true"
+            if stage_enabled:
+                gain += DIRECTIVE_GAIN.get(val, 0.1)
+        elif ".ARGS.MORE." in key and val == "on":
+            gain += 0.03
+    # placement/routing luck, deterministic in the full config
+    gain += 0.25 * _luck(opts, "route")
+
+    # harder to close timing at higher clocks: slack shrinks faster
+    # than the period does
+    wns = target_period * 0.35 - 2.1 + 0.9 * gain
+    tns = min(0.0, wns) * 430.0
+
+    rpt_dir = os.path.join(workdir, "reports", "link", "imp")
+    os.makedirs(rpt_dir, exist_ok=True)
+    rpt = os.path.join(
+        rpt_dir, "xilinx_u280_xdma_201920_1_bb_locked_timing_summary_"
+                 "postroute_physopted.rpt")
+    with open(rpt, "w") as f:
+        f.write(
+            "----------------------------------------------------------\n"
+            "| Design Timing Summary\n"
+            "| ---------------------\n"
+            "----------------------------------------------------------\n"
+            "\n"
+            "    WNS(ns)      TNS(ns)  TNS Failing Endpoints  "
+            "TNS Total Endpoints\n"
+            "    -------      -------  ---------------------  "
+            "-------------------\n"
+            f"    {wns:7.3f}    {tns:9.1f}                      0"
+            "                12000\n")
+
+    # csynth XML for the ut.vhls covariate path (schema of
+    # api/features.py vhls / reference report.py:122-161)
+    lut = int(41000 * (1 + 0.2 * gain))
+    ff = int(52000 * (1 + 0.1 * gain))
+    xml = os.path.join(workdir, "csynth.xml")
+    with open(xml, "w") as f:
+        f.write(f"""<profile>
+  <ReportVersion><Version>2020.1</Version></ReportVersion>
+  <UserAssignments>
+    <ProductFamily>virtexuplusHBM</ProductFamily>
+    <Part>xcu280-fsvh2892-2L-e</Part>
+    <TopModelName>krnl</TopModelName>
+    <unit>ns</unit>
+    <TargetClockPeriod>{target_period:.3f}</TargetClockPeriod>
+  </UserAssignments>
+  <PerformanceEstimates>
+    <SummaryOfTimingAnalysis>
+      <EstimatedClockPeriod>{target_period - wns:.3f}</EstimatedClockPeriod>
+    </SummaryOfTimingAnalysis>
+    <SummaryOfOverallLatency>
+      <Best-caseLatency>4200</Best-caseLatency>
+      <Worst-caseLatency>5150</Worst-caseLatency>
+      <Interval-min>4201</Interval-min>
+      <Interval-max>5151</Interval-max>
+    </SummaryOfOverallLatency>
+  </PerformanceEstimates>
+  <AreaEstimates>
+    <Resources>
+      <BRAM_18K>312</BRAM_18K><DSP48E>224</DSP48E>
+      <FF>{ff}</FF><LUT>{lut}</LUT>
+    </Resources>
+    <AvailableResources>
+      <BRAM_18K>4032</BRAM_18K><DSP48E>9024</DSP48E>
+      <FF>2607360</FF><LUT>1303680</LUT>
+    </AvailableResources>
+  </AreaEstimates>
+</profile>
+""")
+
+
+if __name__ == "__main__":
+    run(sys.argv[1], json.loads(sys.argv[2]))

@@ -1,0 +1,160 @@
+"""Vitis/Vivado implementation-step tuning — the reference's vivado
+sample (/root/reference/samples/vivado/tune_vitis.py:26-151 +
+options.py:12-74): kernel frequency plus the opt/place/phys-opt/route
+directive and MORE-flag pool, written into Vitis config.ini files, QoR =
+achieved post-route period (1000/freq - WNS, minimized).
+
+Runs against `mock_flow.py` (real-format timing summary + csynth XML) by
+default; set UT_VITIS_FLOW to a `run.sh workdir optsjson` wrapper for
+actual builds.  The csynth XML feeds `ut.vhls(..., register=True)` so
+area/latency covariates flow into the archive exactly as the
+reference's `ut.feature` path intends.
+
+    ut samples/vivado/tune_vitis.py -pf 2 --test-limit 40
+"""
+import json
+import math
+import os
+import subprocess
+import sys
+import tempfile
+
+import uptune_tpu as ut
+
+HERE = os.path.dirname(os.path.realpath(__file__))
+
+# option pool (options.py:12-74; first value = default)
+OPTIONS = {
+    "Frequency": (250, 500),
+    "OPT_DESIGN.ARGS.DIRECTIVE": [
+        "Explore", "ExploreArea", "AddRemap", "ExploreSequentialArea",
+        "RuntimeOptimized", "NoBramPowerOpt", "ExploreWithRemap",
+        "Default"],
+    "PLACE_DESIGN.ARGS.DIRECTIVE": [
+        "Explore", "WLDrivenBlockPlacement", "ExtraNetDelay_high",
+        "ExtraNetDelay_low", "SSI_SpreadLogic_high",
+        "SSI_SpreadLogic_low", "AltSpreadLogic_high",
+        "AltSpreadLogic_medium", "AltSpreadLogic_low",
+        "ExtraPostPlacementOpt", "ExtraTimingOpt", "SSI_SpreadSLLs",
+        "SSI_BalanceSLLs", "SSI_BalanceSLRs", "SSI_HighUtilSLRs",
+        "RuntimeOptimized", "Quick", "Default"],
+    "PHYS_OPT_DESIGN.IS_ENABLED": ["true", "false"],
+    "PHYS_OPT_DESIGN.ARGS.DIRECTIVE": [
+        "Explore", "ExploreWithHoldFix", "ExploreWithAggressiveHoldFix",
+        "AggressiveExplore", "AlternateReplication",
+        "AggressiveFanoutOpt", "AddRetime", "AlternateFlowWithRetiming",
+        "Default", "Disabled"],
+    "ROUTE_DESIGN.ARGS.DIRECTIVE": [
+        "Explore", "NoTimingRelaxation", "MoreGlobalIterations",
+        "HigherDelayCost", "RuntimeOptimized", "AlternateCLBRouting",
+        "Quick", "Default"],
+    "ROUTE_DESIGN.ARGS.MORE.tns_cleanup": ["off", "on"],
+    "POST_ROUTE_PHYS_OPT_DESIGN.IS_ENABLED": ["true", "false"],
+    "POST_ROUTE_PHYS_OPT_DESIGN.ARGS.DIRECTIVE": [
+        "AggressiveExplore", "Default"],
+}
+# first value = default, faithful to options.py:46-58 (fanout_opt
+# defaults ON, every other MORE flag defaults off)
+OPTIONS["PHYS_OPT_DESIGN.ARGS.MORE.fanout_opt"] = ["on", "off"]
+for _flag in ("placement_opt", "routing_opt", "rewire",
+              "critical_cell_opt", "dsp_register_opt",
+              "bram_register_opt", "bram_enable_opt",
+              "shift_register_opt", "retime", "critical_pin_opt",
+              "clock_opt", "hold_fix"):
+    OPTIONS[f"PHYS_OPT_DESIGN.ARGS.MORE.{_flag}"] = ["off", "on"]
+
+
+def write_configs(workdir: str, option: dict) -> None:
+    """Emit the Vitis hls/link config.ini pair (tune_vitis.py:26-80):
+    per-stage STEPS properties, MORE-OPTIONS flag groups, disabled
+    stages omitted."""
+    with open(os.path.join(workdir, "hls_config.ini"), "w") as fp:
+        fp.write(f"kernel_frequency={option['Frequency']}\n")
+    with open(os.path.join(workdir, "link_config.ini"), "w") as fp:
+        fp.write(f"kernel_frequency={option['Frequency']}\n[vivado]\n")
+        disabled = {k.split(".")[0] for k, v in option.items()
+                    if k.endswith("IS_ENABLED") and v == "false"}
+        directed = set()
+        for key, val in option.items():
+            if key == "Frequency" or ".ARGS.MORE." in key:
+                continue
+            stage = key.split(".")[0]
+            if key.endswith("IS_ENABLED") and val == "true":
+                fp.write(f"prop=run.impl_1.STEPS.{key}={val}\n")
+            elif key.endswith("ARGS.DIRECTIVE") and stage not in disabled \
+                    and val != "Disabled":
+                fp.write(f"prop=run.impl_1.STEPS.{key}={val}\n")
+                directed.add(stage)
+        flags_by_stage = {}
+        for key, val in option.items():
+            if ".ARGS.MORE." in key and val == "on":
+                stage, flag = key.split(".ARGS.MORE.")
+                flags_by_stage.setdefault(stage, []).append(flag)
+        for stage, flags in flags_by_stage.items():
+            # NOTE: like the reference config() (tune_vitis.py:65-72),
+            # MORE flags are emitted only when the stage has no
+            # directive; ROUTE_DESIGN always has one, so its
+            # tns_cleanup knob only reaches builds when the directive
+            # machinery is bypassed — kept for space parity, and the
+            # mock flow deliberately reads it so search behavior over
+            # the knob is still exercised
+            if stage in disabled or stage in directed:
+                continue
+            joined = " ".join("-" + fl for fl in flags)
+            fp.write("prop=run.impl_1.{{STEPS.{}.MORE OPTIONS}}="
+                     "{{{}}}\n".format(stage, joined))
+
+
+def parse_wns(rpt_path: str) -> float:
+    """WNS from the post-route timing summary: first number six lines
+    under 'Design Timing Summary' (tune_vitis.py:126-139)."""
+    with open(rpt_path) as fp:
+        content = fp.readlines()
+    for i, line in enumerate(content):
+        if "Design Timing Summary" in line:
+            return float(content[i + 6].strip().split()[0])
+    raise ValueError(f"no timing summary in {rpt_path}")
+
+
+def main() -> None:
+    option = {}
+    for key, values in OPTIONS.items():
+        if key == "Frequency":
+            option[key] = ut.tune(300, values, name=key)
+        else:
+            option[key] = ut.tune(values[0], values, name=key)
+
+    workdir = tempfile.mkdtemp(prefix="ut_vitis_")
+    write_configs(workdir, option)
+    flow = os.environ.get("UT_VITIS_FLOW")
+    if flow:
+        subprocess.run([flow, workdir, json.dumps(option)], check=False,
+                       timeout=float(os.environ.get("UT_VITIS_TIMEOUT",
+                                                    7200)))
+    else:
+        subprocess.run([sys.executable,
+                        os.path.join(HERE, "mock_flow.py"),
+                        workdir, json.dumps(option)], check=True,
+                       timeout=600)
+
+    rpt = os.path.join(
+        workdir, "reports", "link", "imp",
+        "xilinx_u280_xdma_201920_1_bb_locked_timing_summary_"
+        "postroute_physopted.rpt")
+    xml = os.path.join(workdir, "csynth.xml")
+    if os.path.isfile(xml):
+        # area/latency covariates into the archive (report.py:122-161)
+        ut.vhls(xml, register=True)
+    if not os.path.isfile(rpt):
+        print("Cannot find vivado timing report...")
+        ut.target(math.inf, "min")
+        return
+    wns = parse_wns(rpt)
+    qor = 1000.0 / float(option["Frequency"]) - wns
+    ut.target(qor, "min")   # achieved period: lower = faster design
+    print(f"freq={option['Frequency']} wns={wns:.3f} "
+          f"achieved_period={qor:.3f}ns")
+
+
+if __name__ == "__main__":
+    main()
