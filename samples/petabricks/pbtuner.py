@@ -24,6 +24,13 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
+# library-mode script: unlike the `ut` CLI (which calls force_cpu
+# itself), plain python must drop the axon TPU-tunnel backend before the
+# first jax op or a wedged tunnel hangs the run during backend init
+from uptune_tpu.utils.platform_guard import force_cpu  # noqa: E402
+
+force_cpu(1)
+
 _TIMING = re.compile(r'<timing\s+time="([0-9.eE+-]+)"')
 
 

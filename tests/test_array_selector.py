@@ -51,7 +51,7 @@ class TestSelector:
             return [cost[c["alg"]] + (c["x"] - 0.5) ** 2 for c in cfgs]
 
         t = Tuner(space, obj, seed=0)
-        res = t.run(test_limit=300)
+        res = t.run(test_limit=180)
         t.close()
         assert res.best_config["alg"] == "fast"
 
@@ -102,7 +102,7 @@ class TestArrays:
                     for c in cfgs]
 
         t = Tuner(space, obj, seed=0)
-        res = t.run(test_limit=400)
+        res = t.run(test_limit=250)
         t.close()
         assert res.best_qor == 0.0
         assert res.best_config["f"] == want

@@ -115,9 +115,9 @@ class TestTuner:
     def test_rosenbrock_float_converges(self):
         space = rosenbrock_space(2, -3.0, 3.0)
         t = Tuner(space, rosenbrock_objective(2), seed=1)
-        res = t.run(test_limit=1500)
+        res = t.run(test_limit=700)
         assert res.best_qor < 1.0, res.best_qor
-        assert res.evals >= 1500
+        assert res.evals >= 700
         # trace is the non-increasing best-so-far curve
         assert all(b <= a + 1e-9 for a, b in zip(res.trace, res.trace[1:]))
 
@@ -125,7 +125,7 @@ class TestTuner:
         space = rosenbrock_space(3, -20, 20, as_int=True)
         obj = make_host_objective(sphere_device, 3)
         t = Tuner(space, obj, seed=0, technique="DifferentialEvolution")
-        res = t.run(test_limit=800)
+        res = t.run(test_limit=500)
         assert res.best_qor <= 3.0
         for i in range(3):
             assert isinstance(res.best_config[f"x{i}"], int)
@@ -137,7 +137,7 @@ class TestTuner:
             return [-(c["x"] - 7.0) ** 2 for c in cfgs]
 
         t = Tuner(space, obj, sense="max", seed=3)
-        res = t.run(test_limit=600)
+        res = t.run(test_limit=350)
         assert res.best_qor > -0.05
         assert abs(res.best_config["x"] - 7.0) < 0.3
 
@@ -227,7 +227,7 @@ class TestTuner:
         space = rosenbrock_space(2, -5.0, 5.0)
         t = Tuner(space, rosenbrock_objective(2), seed=7)
         used = set()
-        for _ in range(40):
+        for _ in range(25):
             used.add(t.step().technique)
         assert len(used) >= 2, used
 

@@ -159,13 +159,13 @@ class TestAnalyze:
 
     def test_learning_curve_improves_with_data(self, tmp_path):
         from uptune_tpu.quickest import learning_curve
-        x, y = _dataset(n=240)
-        xt, yt = _dataset(1, n=120)
+        x, y = _dataset(n=160)
+        xt, yt = _dataset(1, n=80)
         out = learning_curve(x, y[:, 0], xt, yt[:, 0], ["LUT_impl"],
-                             points=3, mlp_steps=120,
+                             points=2, mlp_steps=100,
                              save_dir=str(tmp_path))
         d = out["LUT_impl"]
-        assert len(d["nums"]) == 3 and d["nums"][-1] == 240
+        assert len(d["nums"]) == 2 and d["nums"][-1] == 160
         # more data must not make the held-out fit dramatically worse,
         # and the full-data model must genuinely fit (RRSE < 0.7)
         assert d["test"][-1] < max(d["test"][0] * 1.5, 0.7)

@@ -27,7 +27,7 @@ def archive(tmp_path_factory):
         return [sum(c[f"x{i}"] ** 2 for i in range(3)) for c in cfgs]
 
     t = Tuner(space, obj, seed=0, archive=path)
-    t.run(test_limit=300)
+    t.run(test_limit=200)
     t.close()
     return path
 

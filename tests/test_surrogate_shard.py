@@ -18,12 +18,18 @@ def _fitted(n=96, f=6, seed=0):
     return gp.fit_auto(x, y), x, y
 
 
+@pytest.fixture(scope="class")
+def fitted_env(request):
+    """One GP fit + candidate batch for the whole class: the fit is the
+    expensive part and every test reads it immutably."""
+    request.cls.mesh = make_mesh(n_search=1, n_eval=8)
+    request.cls.state, request.cls.x, request.cls.y = _fitted()
+    kq = jax.random.PRNGKey(9)
+    request.cls.feats = jax.random.uniform(kq, (256, 6))
+
+
+@pytest.mark.usefixtures("fitted_env")
 class TestShardedScore:
-    def setup_method(self):
-        self.mesh = make_mesh(n_search=1, n_eval=8)
-        self.state, self.x, self.y = _fitted()
-        kq = jax.random.PRNGKey(9)
-        self.feats = jax.random.uniform(kq, (512, 6))
 
     def test_mean_matches_dense(self):
         got = sharded_gp_score(self.mesh, "eval", self.state,

@@ -186,7 +186,7 @@ def test_recycling_meta_restarts_fire_and_converge():
               technique="RecyclingMetaTechnique")
     # shrink the window so recycling happens well within the budget
     t.root.window = 4
-    res = t.run(test_limit=900)
+    res = t.run(test_limit=500)
     assert t.root.restart_count > 0, "no member was ever recycled"
     assert res.best_qor < 5.0, res.best_qor
     # restarted members keep proposing (their state re-initialized, not

@@ -20,9 +20,16 @@ import re
 _COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
 
 
-def force_cpu(n_devices: int = 8) -> None:
+def force_cpu(n_devices: int = 8, compile_cache: bool = True) -> None:
     """Select the CPU platform with >= n_devices virtual devices and
-    drop the axon TPU-tunnel backend factory."""
+    drop the axon TPU-tunnel backend factory.
+
+    Also enables the persistent XLA compilation cache (machine-local,
+    `.xla_cache/` at the repo root, override with UT_COMPILE_CACHE_DIR,
+    disable with UT_NO_COMPILE_CACHE=1): the test suite and CPU drives
+    re-jit the same engine/driver programs every process, and the disk
+    cache turns those 7-15s compiles into ~1s loads on every run after
+    the first (measured 6.8s -> 1.1s for the fused engine program)."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
     m = _COUNT_RE.search(flags)
@@ -43,3 +50,23 @@ def force_cpu(n_devices: int = 8) -> None:
         pass  # private API moved: the env vars above still select cpu
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_threefry_partitionable", True)
+    if compile_cache and not os.environ.get("UT_NO_COMPILE_CACHE"):
+        cache_dir = os.environ.get("UT_COMPILE_CACHE_DIR")
+        if not cache_dir:
+            # repo checkout -> .xla_cache at the root; installed package
+            # (three dirnames land in site-packages' parent) -> a user
+            # cache dir, never inside the venv lib tree
+            root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            if os.path.exists(os.path.join(root, "pyproject.toml")):
+                cache_dir = os.path.join(root, ".xla_cache")
+            else:
+                cache_dir = os.path.join(
+                    os.path.expanduser("~"), ".cache", "uptune_tpu",
+                    "xla")
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:
+            pass  # older jax without the persistent cache: no-op
