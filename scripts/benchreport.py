@@ -188,8 +188,21 @@ def gcc_real_problem(payload: str = "qsort", budget: int = 80):
             mine_gcc.config_to_cmd(c, mined), src, expected=expected,
             compile_timeout=90, run_timeout=30) for c in cfgs])
 
-    t_o2 = mine_gcc.build_and_time(["-O2"], src, expected=expected,
-                                   compile_timeout=90, run_timeout=30)
+    # anchor time = min over measurement rounds of 5 runs each, with a
+    # real pause between rounds: on a 1-core box a transient background
+    # burst inflates t_o2, which silently loosens the threshold for the
+    # whole sweep (observed: +15% anchor -> every seed "solved" in 1-4
+    # iters); the pause lets a burst that spans one round end before
+    # the next
+    import time as _time
+    rounds = []
+    for i in range(2):
+        if i:
+            _time.sleep(15.0)
+        rounds.append(mine_gcc.build_and_time(
+            ["-O2"], src, expected=expected, runs=5,
+            compile_timeout=90, run_timeout=30))
+    t_o2 = min(rounds)
     if not math.isfinite(t_o2):
         raise RuntimeError("gcc-real -O2 anchor build failed or did not "
                            "validate; is g++ installed?")
@@ -390,6 +403,22 @@ def to_markdown(rows, seeds):
             ratio = m["surrogate"] / m["baseline"]
             lines.append(f"* **{prob}**: {m['surrogate']:.0f} / "
                          f"{m['baseline']:.0f} = **{ratio:.2f}**")
+    if any(r["censored"] for r in rows):
+        lines += [
+            "",
+            "Censored runs record the eval budget as their iteration",
+            "count, which DEFLATES the censored mode's median: a ratio",
+            "computed against a mode with nonzero censored/seeds",
+            "understates that mode's true cost (it never solved those",
+            "seeds at all).  Per-problem solve rates:",
+            "",
+        ]
+        for r in rows:
+            if r["censored"]:
+                lines.append(
+                    f"* {r['problem']} / {r['mode']}: solved "
+                    f"{r['seeds'] - r['censored']}/{r['seeds']} seeds "
+                    f"within budget")
     lines.append("")
     return "\n".join(lines)
 
