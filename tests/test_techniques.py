@@ -84,6 +84,7 @@ def test_technique_valid_outputs(t, space):
 
 
 @pytest.mark.parametrize("name,steps,target", [
+    ("CMAES", 30, 1e-3),
     ("DifferentialEvolution", 40, 0.05),
     ("NormalGreedyMutation10", 60, 0.05),
     ("PatternSearch", 60, 0.05),
@@ -172,7 +173,23 @@ def test_registry_has_recycling_and_roundrobin():
     names = tb.all_technique_names()
     assert "RecyclingMetaTechnique" in names
     assert "RoundRobinMetaSearchTechnique" in names
-    assert len(names) >= 44, len(names)
+    assert len(names) >= 45, len(names)
+
+
+def test_cmaes_in_driver_and_space_support():
+    """CMA-ES (beyond-reference arm) integrates with the batched driver
+    and declines permutation spaces."""
+    from uptune_tpu.driver.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_objective, rosenbrock_space
+
+    t = tb.get_technique("CMAES")
+    assert not t.supports(mixed_space())     # has a perm block
+    space = rosenbrock_space(2, -3.0, 3.0)
+    tuner = Tuner(space, rosenbrock_objective(2), seed=3,
+                  technique="CMAES")
+    res = tuner.run(test_limit=600)
+    tuner.close()
+    assert res.best_qor < 0.05, res.best_qor
 
 
 def test_recycling_meta_restarts_fire_and_converge():

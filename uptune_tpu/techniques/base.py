@@ -157,4 +157,5 @@ def _ensure_loaded():
         return
     from . import purerandom, de, evolutionary, pso, annealing  # noqa: F401
     from . import pattern, simplex, bandit, banditmutation      # noqa: F401
+    from . import cmaes                                         # noqa: F401
     _loaded = True
