@@ -273,6 +273,17 @@ def _register_portfolios():
         [ugm(mutation_rate=0.01, crossover_rate=0.8, crossover=cx,
              name=f"ga-{cx}") for cx in ("OX3", "OX1", "CX", "PX", "PMX")] +
         [ugm(mutation_rate=0.01, name="ga-base")]))
+    # TPU-flavored portfolio: portfolio A with the UniformGreedyMutation
+    # arm swapped for the beyond-reference CMA-ES (techniques/cmaes.py;
+    # both fill the broad-exploration role, CMA-ES adapts its search
+    # distribution) under the same AUC bandit — opt-in via --technique,
+    # the reference-faithful AUCBanditMetaTechniqueA stays the default
+    from .cmaes import CMAES
+    register(_portfolio("AUCBanditMetaTechniqueTPU", [
+        de_alt(), ugm(sigma=0.1, mutation_rate=0.3,
+                      name="NormalGreedyMutation"),
+        CMAES(), rnm()]))
+
     # the generic restart-meta + plain round-robin, registered so
     # --technique can name them (metatechniques.py:78-180; VERDICT r2
     # missing #4) — both over the default portfolio's members
