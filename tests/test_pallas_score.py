@@ -56,3 +56,55 @@ class TestFusedMeanScores:
         m1 = gp_mean_scores(s1, xq, interpret=True)
         np.testing.assert_allclose(np.asarray(m0), np.asarray(m1),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestMixedKernelScores:
+    def test_mixed_matches_xla_predict(self):
+        """Mixed continuous×categorical state: the two-block pallas
+        kernel must reproduce gp.predict with the n_cont/n_cat split
+        (r4 review: scoring a mixed state through the pure-Matérn path
+        silently drops ls_cat)."""
+        rng = np.random.RandomState(3)
+        n_cont, n_cat, K = 5, 4, 3
+        f = n_cont + n_cat * K
+        codes = rng.randint(K, size=(96, n_cat))
+        oh = np.zeros((96, n_cat, K), np.float32)
+        np.put_along_axis(oh, codes[:, :, None], 1.0, axis=2)
+        x = np.concatenate(
+            [rng.rand(96, n_cont).astype(np.float32),
+             oh.reshape(96, -1) / np.sqrt(2)], axis=1)
+        y = (x[:, 0] * 2 + 3.0 * (codes[:, 1] == 0)
+             + 0.1 * rng.randn(96)).astype(np.float32)
+        st = gp.fit(jnp.asarray(x), jnp.asarray(y), 0.4, 1e-2,
+                    n_cont=n_cont, n_cat=n_cat, ls_cat=0.2)
+        xq = jnp.asarray(x[:64])
+        mu_ref, _ = gp.predict(st, xq, n_cont, n_cat)
+        mu = gp_mean_scores(st, xq, interpret=True,
+                            n_cont=n_cont, n_cat=n_cat)
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(mu_ref),
+                                   rtol=1e-4, atol=1e-5)
+        # and the pure path would NOT have matched (the split matters)
+        mu_wrong = gp_mean_scores(st, xq, interpret=True)
+        assert not np.allclose(np.asarray(mu_wrong), np.asarray(mu_ref),
+                               rtol=1e-3)
+
+    def test_all_categorical_space(self):
+        """n_cont == 0 (pure flag space): the pure exponential-Hamming
+        kernel path must match gp.predict — a zero-width continuous
+        BlockSpec would not lower on TPU (r4 review)."""
+        rng = np.random.RandomState(4)
+        n_cat, K = 6, 3
+        codes = rng.randint(K, size=(80, n_cat))
+        oh = np.zeros((80, n_cat, K), np.float32)
+        np.put_along_axis(oh, codes[:, :, None], 1.0, axis=2)
+        x = oh.reshape(80, -1) / np.sqrt(2)
+        y = (3.0 * (codes[:, 0] == 1) - 2.0 * (codes[:, 3] == 2)
+             + 0.05 * rng.randn(80)).astype(np.float32)
+        st = gp.fit(jnp.asarray(x), jnp.asarray(y), 0.4, 1e-2,
+                    n_cont=0, n_cat=n_cat, ls_cat=0.3)
+        xq = jnp.asarray(x[:48])
+        mu_ref, _ = gp.predict(st, xq, 0, n_cat)
+        mu = gp_mean_scores(st, xq, interpret=True, n_cont=0,
+                            n_cat=n_cat)
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(mu_ref),
+                                   rtol=1e-4, atol=1e-5)

@@ -92,3 +92,30 @@ class TestShardedScore:
                                        jnp.asarray(best))
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestMixedKernelShard:
+    def test_cat_split_matches_dense(self):
+        """A mixed-kernel GPState must score identically sharded vs
+        dense when the n_cont/n_cat split is passed through (r4 review
+        finding)."""
+        from uptune_tpu.space.params import EnumParam, FloatParam
+        from uptune_tpu.space.spec import Space
+
+        mesh = make_mesh(n_search=1, n_eval=8)
+        sp = Space([FloatParam("a", 0, 1), FloatParam("b", 0, 1)]
+                   + [EnumParam(f"f{i}", ("x", "y", "z"))
+                      for i in range(4)])
+        k = jax.random.PRNGKey(3)
+        cands = sp.random(k, 96)
+        feats = sp.surrogate_transform(sp.features(cands))
+        y = feats[:, 0] * 2 + feats[:, 2] - feats[:, 5]
+        nc, ncat = sp.n_cont_features, sp.n_cat
+        st = gp.fit_auto(feats, y, n_cont=nc, n_cat=ncat)
+        q = sp.surrogate_transform(sp.features(
+            sp.random(jax.random.PRNGKey(4), 64)))
+        want = gp.lower_confidence_bound(st, q, n_cont=nc, n_cat=ncat)
+        got = sharded_gp_score(mesh, "eval", st, q, kind="lcb",
+                               n_cont=nc, n_cat=ncat)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)

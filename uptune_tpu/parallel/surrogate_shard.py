@@ -37,7 +37,9 @@ def sharded_gp_score(mesh, axis: str, state: GPState, feats: jax.Array,
                      kind: str = "ei",
                      best_y: Optional[float] = None,
                      key: Optional[jax.Array] = None,
-                     beta: float = 2.0) -> jax.Array:
+                     beta: float = 2.0,
+                     n_cont: Optional[int] = None,
+                     n_cat: int = 0) -> jax.Array:
     """[B, F] candidate features -> [B] acquisition scores, with B
     sharded over `mesh.shape[axis]` devices and the GPState replicated.
 
@@ -45,6 +47,14 @@ def sharded_gp_score(mesh, axis: str, state: GPState, feats: jax.Array,
     over `best_y` (higher = better), 'lcb' the lower confidence bound
     (lower = better), 'thompson' one posterior sample per point (needs
     `key`; per-shard key folding keeps draws independent).
+
+    `n_cont`/`n_cat` are the mixed-kernel split (Space.n_cont_features /
+    Space.n_cat) and MUST match what the state was fitted with: a state
+    fitted over surrogate_transform features scored without them would
+    silently treat the one-hot block as continuous coordinates and drop
+    the fitted ls_cat — multi-chip scores would diverge from
+    single-chip scores on exactly the categorical-heavy spaces the
+    mixed kernel exists for.
     """
     if kind not in SCORES:
         raise ValueError(f"unknown score {kind!r}; known: {SCORES}")
@@ -64,14 +74,16 @@ def sharded_gp_score(mesh, axis: str, state: GPState, feats: jax.Array,
 
     def local(state, best_arr, key_arr, shard):
         if kind == "mean":
-            mu, _ = gp_mod.predict(state, shard)
+            mu, _ = gp_mod.predict(state, shard, n_cont, n_cat)
             return mu
         if kind == "ei":
-            return gp_mod.expected_improvement(state, shard, best_arr)
+            return gp_mod.expected_improvement(state, shard, best_arr,
+                                               n_cont, n_cat)
         if kind == "lcb":
-            return gp_mod.lower_confidence_bound(state, shard, beta)
+            return gp_mod.lower_confidence_bound(state, shard, beta,
+                                                 n_cont, n_cat)
         k = jax.random.fold_in(key_arr, jax.lax.axis_index(axis))
-        return gp_mod.thompson(state, shard, k)
+        return gp_mod.thompson(state, shard, k, n_cont, n_cat)
 
     rep = P()  # replicated
     fn = shard_map(local, mesh=mesh,

@@ -132,6 +132,19 @@ class Space:
         self.int_mask = jnp.asarray(self._int_mask_np)
         # lanes using complex-parameter (randomize-if-differ) semantics
         self.complex_mask = jnp.asarray(kind >= P.COMPLEX_KIND_START)
+        # truly CATEGORICAL lanes (unordered codes — BOOL/SWITCH/ENUM):
+        # surrogate features one-hot these so the GP's Hamming kernel and
+        # the pool's code-flip moves treat "default"/"on"/"off" as
+        # equidistant instead of imposing the unit-lane ordering
+        # (SelectorParam is ordered by design, so it stays numeric)
+        self._cat_mask_np = np.isin(kind, [P.BOOL, P.SWITCH, P.ENUM])
+        self.cat_lane_idx = np.nonzero(self._cat_mask_np)[0]
+        self.num_lane_idx = np.nonzero(~self._cat_mask_np)[0]
+        self.n_cat = int(self._cat_mask_np.sum())
+        # codes per categorical lane (vlo is 0 for these kinds)
+        self.cat_code_counts = (vhi[self._cat_mask_np] + 1).astype(np.int32)
+        self.cat_max_codes = (int(self.cat_code_counts.max())
+                              if self.n_cat else 0)
         self.n_scalar = D
         self.perm_sizes: Tuple[int, ...] = tuple(p.size for p in self.perm_specs)
         # dependency matrices for ScheduleParams ([] entry = no constraint)
@@ -281,6 +294,52 @@ class Space:
                 1, size - 1)
             parts.append(pos)
         return jnp.concatenate(parts, axis=-1) if len(parts) > 1 else parts[0]
+
+    @property
+    def n_cont_features(self) -> int:
+        """Leading continuous-block width of surrogate_transform output."""
+        return (self.n_scalar - self.n_cat) + sum(self.perm_sizes)
+
+    @property
+    def n_surrogate_features(self) -> int:
+        # one-hot blocks are padded to cat_max_codes per lane (vectorized
+        # encode); padding columns are identically 0 on both sides of any
+        # distance, so they are inert
+        return self.n_cont_features + self.n_cat * self.cat_max_codes
+
+    def surrogate_transform(self, feats: jax.Array) -> jax.Array:
+        """features() output [B, n_features] -> surrogate representation
+        [B, n_cont_features + sum(cat codes)]:
+
+        * numeric lanes snapped to their decoded grid (an encode∘decode
+          round-trip), so two configs that decode to the same integer are
+          IDENTICAL in feature space — the raw unit lane carries
+          sub-rounding noise the model would otherwise have to explain;
+        * permutation position lanes passed through;
+        * categorical lanes (BOOL/SWITCH/ENUM) one-hot encoded, scaled by
+          1/sqrt(2) so the squared euclidean distance over the block
+          EQUALS the Hamming distance (# differing lanes) — the GP's
+          categorical kernel reads it straight off one MXU matmul, and
+          unordered codes become equidistant (the reference's XGBoost
+          trees got this for free by splitting; a distance-based model
+          must be told, VERDICT r3 next-step #2).
+
+        Operates on features (not CandBatch) so the driver's existing
+        observe(space.features(...)) plumbing needs no change.
+        """
+        D = self.n_scalar
+        u = feats[..., :D]
+        rest = feats[..., D:]   # perm position lanes
+        u_snap = self.encode_scalars(self.decode_scalars(u))
+        parts = [u_snap[..., self.num_lane_idx], rest]
+        if self.n_cat:
+            codes = self.decode_scalars(u)[..., self.cat_lane_idx]
+            oh = (codes[..., None]
+                  == jnp.arange(self.cat_max_codes, dtype=jnp.float32))
+            oh = oh.reshape(*codes.shape[:-1],
+                            self.n_cat * self.cat_max_codes)
+            parts.append(oh.astype(jnp.float32) * float(1.0 / np.sqrt(2)))
+        return jnp.concatenate(parts, axis=-1)
 
     def hash_batch(self, cands: CandBatch) -> jax.Array:
         """[B] uint64-equivalent hash as a [B, 2] uint32 pair (multiply-shift

@@ -28,30 +28,76 @@ class GPState(NamedTuple):
     lengthscale: jax.Array
     noise: jax.Array
     mask: jax.Array     # [N] 1.0 = real training row, 0.0 = padding
+    # categorical-block lengthscale.  The default MUST be a plain python
+    # float, not jnp.float32(1.0): jnp dtype calls return DEVICE arrays,
+    # and a device array in a class body initializes the XLA backend at
+    # import — which breaks jax.distributed.initialize() in every
+    # multi-process run (and hangs outright on a wedged axon tunnel).
+    ls_cat: float = 1.0
 
 
-def _matern52(x1: jax.Array, x2: jax.Array, ls: jax.Array) -> jax.Array:
-    """[N, F] x [M, F] -> [N, M] Matérn-5/2 kernel.
+def _raw_d2(x1: jax.Array, x2: jax.Array) -> jax.Array:
+    """[N, F] x [M, F] -> [N, M] squared euclidean distances.
 
-    Distances use the matmul identity |a-b|^2 = |a|^2 + |b|^2 - 2ab^T:
-    the O(N*M*F) work lands on the MXU and the largest intermediate is
-    the [N, M] Gram matrix — the broadcast form materializes an
-    [N, M, F] tensor (~400 MB at N=M=1024, F=94), which the
-    marginal-likelihood grid sweep would re-materialize per grid point.
+    Uses the matmul identity |a-b|^2 = |a|^2 + |b|^2 - 2ab^T: the
+    O(N*M*F) work lands on the MXU and the largest intermediate is the
+    [N, M] Gram matrix — the broadcast form materializes an [N, M, F]
+    tensor (~400 MB at N=M=1024, F=94), which the marginal-likelihood
+    grid sweep would re-materialize per grid point.
 
     precision='highest' is load-bearing: TPU matmuls default to bf16
     passes, and the difference-of-squares cancellation amplifies that
-    to ABSOLUTE d2 errors of O(|x/ls|^2 * eps) — measured on TPU, the
+    to ABSOLUTE d2 errors of O(|x|^2 * eps) — measured on TPU, the
     kernel diagonal collapsed to 0.0002 at ls=0.05 without it (f32
     passes restore diag >= 0.997 while keeping the MXU layout)."""
-    a = x1 / ls
-    b = x2 / ls
-    d2 = jnp.maximum(
-        (a * a).sum(-1)[:, None] + (b * b).sum(-1)[None, :]
-        - 2.0 * jnp.matmul(a, b.T, precision="highest"), 0.0)
+    return jnp.maximum(
+        (x1 * x1).sum(-1)[:, None] + (x2 * x2).sum(-1)[None, :]
+        - 2.0 * jnp.matmul(x1, x2.T, precision="highest"), 0.0)
+
+
+def _matern52_from_d2(d2: jax.Array) -> jax.Array:
+    """Matérn-5/2 from ALREADY lengthscale-scaled squared distances."""
     d = jnp.sqrt(d2 + 1e-12)
     s5d = math.sqrt(5.0) * d
     return (1.0 + s5d + (5.0 / 3.0) * d2) * jnp.exp(-s5d)
+
+
+def _matern52(x1: jax.Array, x2: jax.Array, ls: jax.Array) -> jax.Array:
+    """[N, F] x [M, F] -> [N, M] Matérn-5/2 kernel (continuous lanes)."""
+    return _matern52_from_d2(_raw_d2(x1 / ls, x2 / ls))
+
+
+def _kernel_from_d2(d2c: jax.Array, ham, ls, ls_cat,
+                    n_cat: int) -> jax.Array:
+    """Product kernel from precomputed raw distance blocks.
+
+    `d2c`: raw (unit-lengthscale) squared distances over the continuous
+    block; `ham`: Hamming counts over the categorical block (or None),
+    which the 1/sqrt(2)-scaled one-hot encoding in
+    Space.surrogate_transform makes equal to ITS raw squared distances.
+
+        k = Matérn52(d2c / ls²) · exp(-(ham / n_cat) / ls_cat)
+
+    The exponential-Hamming factor is the categorical half the r3
+    verdict asked for: an isotropic Matérn over one-hot lanes imposes a
+    single shared lengthscale, letting 232 flag lanes drown the 95
+    numeric ones; the product form gives each block its own scale,
+    selected by marginal likelihood.  Both factors are 1 at distance 0,
+    so the prior variance stays 1 and predict()'s variance algebra is
+    unchanged."""
+    k = _matern52_from_d2(d2c / (ls * ls))
+    if ham is not None and n_cat:
+        k = k * jnp.exp(-(ham / float(n_cat)) / ls_cat)
+    return k
+
+
+def _d2_blocks(x1: jax.Array, x2: jax.Array, n_cont):
+    """Split features at static column `n_cont` and return the two raw
+    distance blocks (continuous d2, categorical Hamming-count)."""
+    if n_cont is None or n_cont >= x1.shape[-1]:
+        return _raw_d2(x1, x2), None
+    return (_raw_d2(x1[:, :n_cont], x2[:, :n_cont]),
+            _raw_d2(x1[:, n_cont:], x2[:, n_cont:]))
 
 
 def _standardize(y: jax.Array, mask: Optional[jax.Array]
@@ -78,51 +124,70 @@ def _standardize(y: jax.Array, mask: Optional[jax.Array]
     return yn, mean, std
 
 
-def _masked_kernel(x: jax.Array, ls: jax.Array, noise: jax.Array,
-                   mask: Optional[jax.Array]) -> jax.Array:
+def _mask_adjust(k: jax.Array, noise: jax.Array,
+                 mask: Optional[jax.Array]) -> jax.Array:
     """K + noise*I with padded rows replaced by independent unit-variance
     points: zero off-diagonal coupling, 1 on the diagonal.  The Cholesky
     of such a matrix leaves the real-row entries identical to the
     unpadded factorization, so padding changes nothing numerically —
     it only makes the shape static for jit-cache reuse."""
-    k = _matern52(x, x, ls)
     if mask is not None:
         mm = mask[:, None] * mask[None, :]
         k = mm * k + jnp.diag(1.0 - mask)
-    return k + noise * jnp.eye(x.shape[0])
+    return k + noise * jnp.eye(k.shape[0])
 
 
 def fit(x: jax.Array, y: jax.Array, lengthscale: float = 0.3,
         noise: float = 1e-3,
-        mask: Optional[jax.Array] = None) -> GPState:
+        mask: Optional[jax.Array] = None,
+        n_cont: Optional[int] = None, n_cat: int = 0,
+        ls_cat: float = 1.0) -> GPState:
     """Exact GP fit at fixed hyperparameters.  `mask` ([N] 1.0=real,
     0.0=padding) lets callers pad the training set to a bucketed static
-    shape without recompiles or result changes."""
+    shape without recompiles or result changes.  `n_cont`/`n_cat`
+    (static) activate the mixed continuous×categorical kernel over
+    Space.surrogate_transform features; the defaults reproduce the pure
+    Matérn behavior exactly."""
     yn, mean, std = _standardize(y, mask)
     ls = jnp.asarray(lengthscale, jnp.float32)
     nz = jnp.asarray(noise, jnp.float32)
-    k = _masked_kernel(x, ls, nz, mask)
+    lc = jnp.asarray(ls_cat, jnp.float32)
+    d2c, ham = _d2_blocks(x, x, n_cont)
+    k = _mask_adjust(_kernel_from_d2(d2c, ham, ls, lc, n_cat), nz, mask)
     chol = jnp.linalg.cholesky(k)
     alpha = jax.scipy.linalg.cho_solve((chol, True), yn)
     m = jnp.ones(x.shape[0]) if mask is None else mask
-    return GPState(x, alpha, chol, mean, std, ls, nz, m)
+    return GPState(x, alpha, chol, mean, std, ls, nz, m, lc)
 
 
 # hyperparameter grid for fit_auto: log-spaced lengthscales (unit-cube
 # features, so 0.03..5 covers very wiggly..nearly-linear) x noise floors
 DEFAULT_LS_GRID = (0.05, 0.1, 0.2, 0.3, 0.5, 0.8, 1.3, 2.0, 3.0)
 DEFAULT_NOISE_GRID = (1e-4, 1e-3, 1e-2, 1e-1)
+# categorical lengthscales: ls_cat ~ the Hamming FRACTION over which
+# correlation decays by 1/e — 0.02 ≈ "a handful of flag flips decorrelate"
+# up to 2.0 ≈ "flags barely matter"
+DEFAULT_LS_CAT_GRID = (0.02, 0.05, 0.1, 0.2, 0.5, 1.0, 2.0)
 
 
 def log_marginal_likelihood(x: jax.Array, y: jax.Array,
                             lengthscale: jax.Array, noise: jax.Array,
-                            mask: Optional[jax.Array] = None) -> jax.Array:
+                            mask: Optional[jax.Array] = None,
+                            n_cont: Optional[int] = None, n_cat: int = 0,
+                            ls_cat=1.0) -> jax.Array:
     """Exact GP log evidence on standardized targets; padded rows
     contribute exactly zero (their quadratic term is 0 and their
     log-diagonal entries are masked out)."""
     yn, _, _ = _standardize(y, mask)
-    k = _masked_kernel(x, jnp.asarray(lengthscale, jnp.float32),
-                       jnp.asarray(noise, jnp.float32), mask)
+    d2c, ham = _d2_blocks(x, x, n_cont)
+    k = _mask_adjust(
+        _kernel_from_d2(d2c, ham, jnp.asarray(lengthscale, jnp.float32),
+                        jnp.asarray(ls_cat, jnp.float32), n_cat),
+        jnp.asarray(noise, jnp.float32), mask)
+    return _mll_from_k(k, yn, mask, x.shape[0])
+
+
+def _mll_from_k(k, yn, mask, n_rows) -> jax.Array:
     chol = jnp.linalg.cholesky(k)
     alpha = jax.scipy.linalg.cho_solve((chol, True), yn)
     logdiag = jnp.log(jnp.diagonal(chol))
@@ -130,7 +195,7 @@ def log_marginal_likelihood(x: jax.Array, y: jax.Array,
         logdiag = logdiag * mask
         n = mask.sum()
     else:
-        n = float(x.shape[0])
+        n = float(n_rows)
     return (-0.5 * (yn * alpha).sum() - logdiag.sum()
             - 0.5 * n * math.log(2 * math.pi))
 
@@ -138,33 +203,65 @@ def log_marginal_likelihood(x: jax.Array, y: jax.Array,
 def fit_auto(x: jax.Array, y: jax.Array,
              mask: Optional[jax.Array] = None,
              ls_grid: Sequence[float] = DEFAULT_LS_GRID,
-             noise_grid: Sequence[float] = DEFAULT_NOISE_GRID) -> GPState:
-    """Fit with (lengthscale, noise) chosen by marginal likelihood over a
-    grid — the round-1 fixed (0.3, 1e-3) had no evidence behind it
-    (VERDICT weak #5).  The grid sweep is one lax.map of Cholesky solves
-    (static shapes, MXU-friendly); the winner is refit once.
+             noise_grid: Sequence[float] = DEFAULT_NOISE_GRID,
+             n_cont: Optional[int] = None, n_cat: int = 0,
+             ls_cat_grid: Sequence[float] = DEFAULT_LS_CAT_GRID
+             ) -> GPState:
+    """Fit with (lengthscale, noise[, ls_cat]) chosen by marginal
+    likelihood over a grid — the round-1 fixed (0.3, 1e-3) had no
+    evidence behind it (VERDICT weak #5).  The raw distance blocks are
+    computed ONCE (two MXU matmuls) and shared across the whole grid;
+    the lax.map sweep is then pure elementwise-transform + Cholesky per
+    point (static shapes), and the winner is refit once.
 
     The reference's XGBoost surrogate tunes nothing online either
     (plugins/xgbregressor.py:35-44 hardcodes 300 trees / depth 10); this
-    is where the GP must earn its ranking-quality parity."""
-    grid = jnp.asarray([(ls, nz) for ls in ls_grid for nz in noise_grid],
-                       jnp.float32)
+    is where the GP must earn its ranking-quality parity.
+
+    With categoricals the hyperparameter space is 3-D; the full product
+    grid would be 9×4×7 = 252 Cholesky factorizations per refit — and
+    the O(N³) Cholesky, not the (shared) distance matmuls, dominates at
+    N≳512.  Instead: coordinate descent — sweep (ls, noise) at the
+    middle ls_cat, then sweep ls_cat at that winner (36 + 7 = 43
+    factorizations, ~6× cheaper)."""
+    has_cat = n_cat > 0 and n_cont is not None and n_cont < x.shape[-1]
+    yn, _, _ = _standardize(y, mask)
+    d2c, ham = _d2_blocks(x, x, n_cont)
 
     def mll(hp):
-        return log_marginal_likelihood(x, y, hp[0], hp[1], mask)
+        k = _mask_adjust(_kernel_from_d2(d2c, ham, hp[0], hp[2], n_cat),
+                         hp[1], mask)
+        return _mll_from_k(k, yn, mask, x.shape[0])
 
-    scores = jax.lax.map(mll, grid)
-    # a near-singular K (f32 Cholesky on clustered configs) yields NaN
-    # evidence; NaN wins argmax and poisons the refit — mask it out
-    scores = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
-    best = jnp.argmax(scores)
-    ls, nz = grid[best, 0], grid[best, 1]
-    return fit(x, y, ls, nz, mask)
+    def sweep(grid):
+        scores = jax.lax.map(mll, grid)
+        # a near-singular K (f32 Cholesky on clustered configs) yields
+        # NaN evidence; NaN wins argmax and poisons the refit — mask it
+        scores = jnp.where(jnp.isnan(scores), -jnp.inf, scores)
+        return grid[jnp.argmax(scores)]
+
+    cat_grid = tuple(ls_cat_grid)
+    mid = cat_grid[len(cat_grid) // 2] if has_cat else 1.0
+    g1 = jnp.asarray([(ls, nz, mid) for ls in ls_grid
+                      for nz in noise_grid], jnp.float32)
+    best = sweep(g1)
+    if has_cat:
+        g2 = jnp.stack([
+            jnp.full((len(cat_grid),), best[0]),
+            jnp.full((len(cat_grid),), best[1]),
+            jnp.asarray(cat_grid, jnp.float32)], axis=1)
+        best = sweep(g2)
+    return fit(x, y, best[0], best[1], mask,
+               n_cont=n_cont, n_cat=n_cat, ls_cat=best[2])
 
 
-def predict(state: GPState, xq: jax.Array) -> Tuple[jax.Array, jax.Array]:
+def predict(state: GPState, xq: jax.Array,
+            n_cont: Optional[int] = None, n_cat: int = 0
+            ) -> Tuple[jax.Array, jax.Array]:
     """[B, F] -> (mean [B], std [B]) in original target units."""
-    kq = _matern52(xq, state.x, state.lengthscale)       # [B, N]
+    d2c, ham = _d2_blocks(xq, state.x, n_cont)
+    kq = _kernel_from_d2(d2c, ham, state.lengthscale, state.ls_cat,
+                         n_cat)                           # [B, N]
     kq = kq * state.mask[None, :]   # padded rows must not shrink variance
     mu = kq @ state.alpha
     v = jax.scipy.linalg.solve_triangular(state.chol, kq.T, lower=True)
@@ -186,23 +283,28 @@ def ei_from_moments(mu: jax.Array, sd: jax.Array,
 
 
 def expected_improvement(state: GPState, xq: jax.Array,
-                         best: jax.Array) -> jax.Array:
+                         best: jax.Array,
+                         n_cont: Optional[int] = None,
+                         n_cat: int = 0) -> jax.Array:
     """EI for minimization: E[max(best - f, 0)]."""
-    mu, sd = predict(state, xq)
+    mu, sd = predict(state, xq, n_cont, n_cat)
     return ei_from_moments(mu, sd, best)
 
 
 def lower_confidence_bound(state: GPState, xq: jax.Array,
-                           beta: float = 2.0) -> jax.Array:
+                           beta: float = 2.0,
+                           n_cont: Optional[int] = None,
+                           n_cat: int = 0) -> jax.Array:
     """LCB for minimization (lower = more promising)."""
-    mu, sd = predict(state, xq)
+    mu, sd = predict(state, xq, n_cont, n_cat)
     return mu - beta * sd
 
 
-def thompson(state: GPState, xq: jax.Array, key: jax.Array) -> jax.Array:
+def thompson(state: GPState, xq: jax.Array, key: jax.Array,
+             n_cont: Optional[int] = None, n_cat: int = 0) -> jax.Array:
     """One posterior sample per query point (diagonal approximation —
     batch-cheap; full joint sampling would need the [B, B] posterior)."""
-    mu, sd = predict(state, xq)
+    mu, sd = predict(state, xq, n_cont, n_cat)
     return mu + sd * jax.random.normal(key, mu.shape)
 
 

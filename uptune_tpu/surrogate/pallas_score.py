@@ -29,6 +29,19 @@ ROWS = 8            # output rows per grid step (sublane minimum)
 TILE = LANES * ROWS  # candidate rows per grid step (2048)
 
 
+def _tile_d2(a, b):
+    d2 = ((a * a).sum(axis=1, keepdims=True)
+          + (b * b).sum(axis=1)[None, :]
+          - 2.0 * jnp.dot(a, b.T, preferred_element_type=jnp.float32))
+    return jnp.maximum(d2, 0.0)
+
+
+def _matern_tile(d2):
+    d = jnp.sqrt(d2 + 1e-12)
+    s5d = math.sqrt(5.0) * d
+    return (1.0 + s5d + (5.0 / 3.0) * d2) * jnp.exp(-s5d)
+
+
 def _score_kernel(xq_ref, x_ref, alpha_ref, out_ref):
     """One tile: out[T] = matern52(xq_tile, X) @ alpha.
 
@@ -36,18 +49,32 @@ def _score_kernel(xq_ref, x_ref, alpha_ref, out_ref):
     alpha, and the caller zeroes alpha on padded rows."""
     a = xq_ref[:]                        # [T, F]  (pre-scaled by 1/ls)
     b = x_ref[:]                         # [N, F]
-    d2 = ((a * a).sum(axis=1, keepdims=True)
-          + (b * b).sum(axis=1)[None, :]
-          - 2.0 * jnp.dot(a, b.T, preferred_element_type=jnp.float32))
-    d2 = jnp.maximum(d2, 0.0)
-    d = jnp.sqrt(d2 + 1e-12)
-    s5d = math.sqrt(5.0) * d
-    k = (1.0 + s5d + (5.0 / 3.0) * d2) * jnp.exp(-s5d)   # [T, N]
+    k = _matern_tile(_tile_d2(a, b))     # [T, N]
     out_ref[:] = (k @ alpha_ref[:]).reshape(ROWS, LANES)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _mean_scores_padded(xq_scaled, x_scaled, alpha, interpret: bool):
+def _score_kernel_mixed(xq_c_ref, xq_k_ref, x_c_ref, x_k_ref, alpha_ref,
+                        out_ref):
+    """Mixed-kernel tile: Matérn over the continuous block × an
+    exponential-Hamming factor over the categorical one-hot block (the
+    gp.py product kernel).  Both raw-distance tiles ride the MXU; the
+    caller pre-scales the cont block by 1/ls and the cat block by
+    sqrt(1/(n_cat·ls_cat)), so here k = matern(d2c) · exp(-d2k)."""
+    k = _matern_tile(_tile_d2(xq_c_ref[:], x_c_ref[:]))
+    k = k * jnp.exp(-_tile_d2(xq_k_ref[:], x_k_ref[:]))
+    out_ref[:] = (k @ alpha_ref[:]).reshape(ROWS, LANES)
+
+
+def _score_kernel_expham(xq_k_ref, x_k_ref, alpha_ref, out_ref):
+    """Pure exponential-Hamming tile for ALL-categorical spaces
+    (n_cont == 0): a zero-width continuous BlockSpec would not lower
+    through Mosaic, so the Matérn factor — identically 1 there — is
+    omitted instead."""
+    k = jnp.exp(-_tile_d2(xq_k_ref[:], x_k_ref[:]))
+    out_ref[:] = (k @ alpha_ref[:]).reshape(ROWS, LANES)
+
+
+def _pl_setup():
     from jax.experimental import pallas as pl
     try:
         from jax.experimental.pallas import tpu as pltpu
@@ -55,13 +82,18 @@ def _mean_scores_padded(xq_scaled, x_scaled, alpha, interpret: bool):
     except ImportError:  # pragma: no cover
         vmem = None
 
-    B, F = xq_scaled.shape
-    N = x_scaled.shape[0]
-    grid = (B // TILE,)
-
     def spec(shape, index_map=None):
         kw = {"memory_space": vmem} if vmem is not None else {}
         return pl.BlockSpec(shape, index_map, **kw)
+
+    return pl, spec
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mean_scores_padded(xq_scaled, x_scaled, alpha, interpret: bool):
+    pl, spec = _pl_setup()
+    B, F = xq_scaled.shape
+    N = x_scaled.shape[0]
 
     # 2D [B/LANES, LANES] output in (ROWS, LANES) blocks: 1D f32 outputs
     # trip a Mosaic/XLA tile-layout mismatch (observed: XLA {0:T(1024)}
@@ -69,7 +101,7 @@ def _mean_scores_padded(xq_scaled, x_scaled, alpha, interpret: bool):
     out = pl.pallas_call(
         _score_kernel,
         out_shape=jax.ShapeDtypeStruct((B // LANES, LANES), jnp.float32),
-        grid=grid,
+        grid=(B // TILE,),
         in_specs=[
             spec((TILE, F), lambda i: (i, 0)),
             spec((N, F), lambda i: (0, 0)),
@@ -81,24 +113,91 @@ def _mean_scores_padded(xq_scaled, x_scaled, alpha, interpret: bool):
     return out.reshape(B)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mean_scores_padded_expham(xq_k, x_k, alpha, interpret: bool):
+    pl, spec = _pl_setup()
+    B, Fk = xq_k.shape
+    N = x_k.shape[0]
+    out = pl.pallas_call(
+        _score_kernel_expham,
+        out_shape=jax.ShapeDtypeStruct((B // LANES, LANES), jnp.float32),
+        grid=(B // TILE,),
+        in_specs=[
+            spec((TILE, Fk), lambda i: (i, 0)),
+            spec((N, Fk), lambda i: (0, 0)),
+            spec((N,), lambda i: (0,)),
+        ],
+        out_specs=spec((ROWS, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(xq_k, x_k, alpha)
+    return out.reshape(B)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mean_scores_padded_mixed(xq_c, xq_k, x_c, x_k, alpha,
+                              interpret: bool):
+    pl, spec = _pl_setup()
+    B, Fc = xq_c.shape
+    Fk = xq_k.shape[1]
+    N = x_c.shape[0]
+    out = pl.pallas_call(
+        _score_kernel_mixed,
+        out_shape=jax.ShapeDtypeStruct((B // LANES, LANES), jnp.float32),
+        grid=(B // TILE,),
+        in_specs=[
+            spec((TILE, Fc), lambda i: (i, 0)),
+            spec((TILE, Fk), lambda i: (i, 0)),
+            spec((N, Fc), lambda i: (0, 0)),
+            spec((N, Fk), lambda i: (0, 0)),
+            spec((N,), lambda i: (0,)),
+        ],
+        out_specs=spec((ROWS, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(xq_c, xq_k, x_c, x_k, alpha)
+    return out.reshape(B)
+
+
 def gp_mean_scores(state, xq: jax.Array,
-                   interpret: bool = None) -> jax.Array:
+                   interpret: bool = None,
+                   n_cont=None, n_cat: int = 0) -> jax.Array:
     """Posterior mean for a [B, F] query batch against a fitted GPState,
     without materializing the [B, N] cross-kernel in HBM.
 
-    Numerically equivalent to gp.predict(state, xq)[0]; `interpret`
-    defaults to True off-TPU (pallas CPU path) and False on TPU."""
+    Numerically equivalent to gp.predict(state, xq, n_cont, n_cat)[0];
+    `n_cont`/`n_cat` MUST match the fit (a mixed-kernel state scored
+    without them would treat one-hot flag lanes as continuous
+    coordinates and drop ls_cat).  `interpret` defaults to True off-TPU
+    (pallas CPU path) and False on TPU."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, F = xq.shape
     pad = (-B) % TILE
-    xq_scaled = (jnp.asarray(xq, jnp.float32) / state.lengthscale)
+    xq32 = jnp.asarray(xq, jnp.float32)
     if pad:
-        xq_scaled = jnp.concatenate(
-            [xq_scaled, jnp.zeros((pad, F), jnp.float32)])
-    x_scaled = jnp.asarray(state.x, jnp.float32) / state.lengthscale
+        xq32 = jnp.concatenate([xq32, jnp.zeros((pad, F), jnp.float32)])
+    x32 = jnp.asarray(state.x, jnp.float32)
     alpha = jnp.asarray(state.alpha, jnp.float32) * state.mask
-    mu_n = _mean_scores_padded(xq_scaled, x_scaled, alpha,
-                               bool(interpret))
+    mixed = n_cont is not None and n_cat and n_cont < F
+    if mixed:
+        # cont block scaled by 1/ls (Matérn); cat one-hot block scaled
+        # by sqrt(1/(n_cat·ls_cat)) so its raw squared distance is
+        # already the exponent of the Hamming factor
+        cat_s = jnp.sqrt(1.0 / (float(n_cat) * state.ls_cat))
+        if n_cont == 0:
+            # all-categorical space: a zero-width continuous block
+            # cannot lower through Mosaic; the Matérn factor is 1
+            mu_n = _mean_scores_padded_expham(
+                xq32 * cat_s, x32 * cat_s, alpha, bool(interpret))
+        else:
+            mu_n = _mean_scores_padded_mixed(
+                xq32[:, :n_cont] / state.lengthscale,
+                xq32[:, n_cont:] * cat_s,
+                x32[:, :n_cont] / state.lengthscale,
+                x32[:, n_cont:] * cat_s,
+                alpha, bool(interpret))
+    else:
+        mu_n = _mean_scores_padded(xq32 / state.lengthscale,
+                                   x32 / state.lengthscale,
+                                   alpha, bool(interpret))
     mu = mu_n[:B] if pad else mu_n
     return mu * state.y_std + state.y_mean
