@@ -60,17 +60,46 @@ def _flag_works(cc: str, opts: List[str]) -> bool:
 def mine(cc: str = "g++", use_cache: bool = True,
          max_flags: Optional[int] = None,
          max_params: Optional[int] = None) -> Dict[str, object]:
-    """-> {'version', 'flags': [...], 'params': {name: [lo, hi, dflt]}}"""
-    version = _cc_version(cc)
-    if use_cache and os.path.exists(_CACHE):
-        try:
-            with open(_CACHE) as f:
-                cached = json.load(f)
-            if cached.get("version") == version:
-                return cached
-        except (json.JSONDecodeError, OSError):
-            pass
+    """-> {'version', 'flags': [...], 'params': {name: [lo, hi, dflt]}}
 
+    Concurrency: on a cold cache, N parallel sandboxes (`ut ... -pf N`
+    imports this in every worker) would each run the full ~1-2 min
+    flag-validity sweep.  An exclusive flock serializes them: one worker
+    mines while the rest block on the lock, then read the cache it
+    wrote (ADVICE r3)."""
+    version = _cc_version(cc)
+
+    def _read_cache():
+        if use_cache and os.path.exists(_CACHE):
+            try:
+                with open(_CACHE) as f:
+                    cached = json.load(f)
+                if cached.get("version") == version:
+                    return cached
+            except (json.JSONDecodeError, OSError):
+                pass
+        return None
+
+    cached = _read_cache()
+    if cached is not None:
+        return cached
+    if use_cache:
+        import fcntl
+        with open(_CACHE + ".lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                # whoever held the lock before us probably mined already
+                cached = _read_cache()
+                if cached is not None:
+                    return cached
+                return _mine_uncached(cc, version, use_cache,
+                                      max_flags, max_params)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
+    return _mine_uncached(cc, version, use_cache, max_flags, max_params)
+
+
+def _mine_uncached(cc, version, use_cache, max_flags, max_params):
     out = subprocess.run([cc, "--help=optimizers"], capture_output=True,
                          text=True, timeout=60)
     candidates = sorted(set(_FLAG_LINE.findall(out.stdout)))
@@ -159,15 +188,19 @@ def anchor_output(src: str, extra: List[str] = (), cc: str = "g++",
                   use_cache: bool = True) -> bytes:
     """Reference stdout of a plain -O2 build of `src` — the output every
     tuned build must reproduce.  Cached next to this file keyed by a
-    digest of (compiler version, payload source), so editing the payload
-    or switching compilers invalidates the cache instead of silently
-    failing every trial against a stale checksum."""
+    digest of (compiler version, payload source, extra build args), so
+    editing the payload, switching compilers, or passing different
+    `extra` defines invalidates the cache instead of silently validating
+    trials against a wrong anchor (a payload whose output depends on a
+    tuned -D would otherwise bake the first trial's define into the
+    cached anchor)."""
     import hashlib
 
     with open(src, "rb") as f:
         payload = f.read()
     digest = hashlib.sha256(
-        _cc_version(cc).encode() + b"\0" + payload).hexdigest()[:12]
+        _cc_version(cc).encode() + b"\0" + payload + b"\0"
+        + " ".join(extra).encode()).hexdigest()[:12]
     stem = os.path.splitext(os.path.basename(src))[0]
     cache = os.path.join(_DIR, f".anchor_{stem}_{digest}.bin")
     if use_cache and os.path.exists(cache):

@@ -40,6 +40,7 @@ Usage: python scripts/benchreport.py [--seeds 30] [--quick] [--out md]
 """
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -174,8 +175,21 @@ def gcc_real_problem(payload: str = "qsort", budget: int = 80):
 
     mined = mine_gcc.mine()
     space = mine_gcc.build_space(mined)
+    # seed config for BOTH modes (the CLI's declared-defaults seed trial,
+    # exec/controller.py; the reference's first trial is likewise the
+    # user's written defaults — tune_gcc.py declares "-O2"): -O2, every
+    # flag untouched, every --param at the compiler's own default
+    prob_name = "gcc-real" if payload == "qsort" else f"gcc-real-{payload}"
+    SEED_CONFIGS[prob_name] = [{
+        "olevel": "-O2",
+        **{fl: "default" for fl in mined["flags"]},
+        **{n: int(min(max(d, lo), hi))
+           for n, (lo, hi, d) in mined["params"].items()},
+    }]
+    src_name = "mmm_block.cpp" if payload == "mmm" \
+        else f"payload_{payload}.cpp"
     src = os.path.join(os.path.dirname(os.path.abspath(
-        mine_gcc.__file__)), f"payload_{payload}.cpp")
+        mine_gcc.__file__)), src_name)
 
     # anchor: plain -O2 defines both the time-to-beat and the reference
     # output every tuned build must reproduce (the correctness gate in
@@ -206,7 +220,14 @@ def gcc_real_problem(payload: str = "qsort", budget: int = 80):
     if not math.isfinite(t_o2):
         raise RuntimeError("gcc-real -O2 anchor build failed or did not "
                            "validate; is g++ installed?")
-    thresh = 0.85 * t_o2
+    # 22% under -O2: with the declared-defaults seed trial (-O2 itself)
+    # now injected into every run, the old 15% bar fell inside the first
+    # technique batch for baseline AND surrogate (both solved in 6 iters,
+    # r4 calibration) — it stopped measuring search.  The tuned optimum
+    # on this box is ~29% under -O2, so 22% is reachable but requires
+    # genuine flag-space search.  Full traces are stored per run, so any
+    # other threshold can be re-evaluated post-hoc without re-compiling.
+    thresh = 0.78 * t_o2
     print(f"gcc-real: |space|={len(space.specs)} params, "
           f"-O2 anchor {t_o2:.4f}s, threshold {thresh:.4f}s",
           file=sys.stderr)
@@ -218,11 +239,44 @@ PROBLEMS = {
     "rosenbrock-2d": lambda: rosenbrock_problem(2),
     "rosenbrock-4d": lambda: rosenbrock_problem(4),
     "gcc-options": gcc_problem,
-    # real-build problem: resolvable by name but excluded from the
+    # real-build problems: resolvable by name but excluded from the
     # default sweep (real compiles; see gcc_real_problem docstring)
     "gcc-real": gcc_real_problem,
+    "gcc-real-mmm": lambda: gcc_real_problem("mmm"),
 }
-DEFAULT_PROBLEMS = [p for p in PROBLEMS if p != "gcc-real"]
+DEFAULT_PROBLEMS = [p for p in PROBLEMS if not p.startswith("gcc-real")]
+
+# problem -> configs injected as seed trials before run() for EVERY mode
+# (populated by problem factories; empty for the synthetic spaces so
+# their published 30-seed rows stay valid)
+SEED_CONFIGS = {}
+
+# Static full budgets, mirroring what each factory returns.  The --rows
+# staleness merge reads budgets from HERE, never by instantiating the
+# factory: gcc_real_problem() mines the real g++ space and runs two -O2
+# anchor builds plus a 15 s settle — side effects a merge-only pass must
+# not trigger (and that raise on a g++-less box, killing the --out write
+# after the sweep already finished).  run_suite() asserts the factory's
+# budget against this table, so drift is caught on every real run.
+PROBLEM_BUDGETS = {
+    "rosenbrock-2d": 2000,
+    "rosenbrock-4d": 4000,
+    "gcc-options": 6000,
+    "gcc-real": 80,
+    "gcc-real-mmm": 80,
+}
+
+# Measurement-protocol version per problem: bumped whenever the way a
+# row is MEASURED changes (threshold definition, seeding, payload) —
+# budget+sopts_sig alone cannot see such changes, so without this a
+# state/rows file carrying pre-change rows would silently merge two
+# protocols into one table (r4: gcc-real gained the -O2 seed trial and
+# moved the threshold 0.85→0.78×t_O2).  Synthetic problems are at their
+# original protocol (None == legacy rows remain valid).
+PROBLEM_PROTO = {
+    "gcc-real": "v2:seeded+0.78xO2",
+    "gcc-real-mmm": "v2:seeded+0.78xO2",
+}
 
 
 # ---------------------------------------------------------------- runs
@@ -273,13 +327,28 @@ def one_run(problem: str, mode: str, seed: int, budget: int,
     tuner = Tuner(space, objective, seed=seed, surrogate=surrogate,
                   surrogate_opts=sopts)
     t0 = time.time()
+    # seed trials (identical for every mode): library-mode analogue of
+    # the CLI's declared-defaults seed (exec/controller.py seed trial)
+    seed_cfgs = SEED_CONFIGS.get(problem)
+    if seed_cfgs:
+        for tr_ in tuner.inject(seed_cfgs, "seed"):
+            tuner.tell(tr_, float(np.asarray(
+                objective([tr_.config])).reshape(-1)[0]))
     res = tuner.run(test_limit=budget, target=thresh)
     wall = time.time() - t0
     tuner.close()
     it = iters_to_threshold(res.trace, thresh, budget)
-    return {"iters": it, "best": res.best_qor, "evals": res.evals,
-            "wall_s": round(wall, 1),
-            "censored": it >= budget and res.best_qor > thresh}
+    row = {"iters": it, "best": res.best_qor, "evals": res.evals,
+           "wall_s": round(wall, 1),
+           "censored": it >= budget and res.best_qor > thresh}
+    if problem.startswith("gcc-real"):
+        # real-build runs are expensive: store the full best-so-far
+        # trace (and the threshold it was judged against) so any other
+        # threshold can be evaluated post-hoc without re-compiling
+        row["thresh"] = round(float(thresh), 6)
+        row["trace"] = [None if not math.isfinite(v) else round(v, 6)
+                        for v in res.trace]
+    return row
 
 
 def _sopts_sig(mode: str):
@@ -311,7 +380,11 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
     state_f = open(state_path, "a") if state_path else None
     rows = []
     for prob in problems:
-        budget = int(PROBLEMS[prob]()[3] * budget_scale)
+        full_budget = PROBLEMS[prob]()[3]
+        assert full_budget == PROBLEM_BUDGETS[prob], (
+            f"{prob}: factory budget {full_budget} != static table "
+            f"{PROBLEM_BUDGETS[prob]} — update PROBLEM_BUDGETS")
+        budget = int(full_budget * budget_scale)
         for mode in (_norm_mode(m) for m in modes):
             per_seed = []
             for s in range(seeds):
@@ -324,14 +397,18 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
                 # not be reported as the current mode's numbers (legacy
                 # rows without the fields are always re-run)
                 sig = _sopts_sig(mode)
+                proto = PROBLEM_PROTO.get(prob)
                 if cached is not None and \
                         cached.get("budget") == budget and \
-                        cached.get("sopts_sig") == sig:
+                        cached.get("sopts_sig") == sig and \
+                        cached.get("proto") == proto:
                     per_seed.append(cached)
                     continue
                 r = one_run(prob, mode, seed=1000 + s, budget=budget)
                 r["budget"] = budget
                 r["sopts_sig"] = sig
+                if proto is not None:
+                    r["proto"] = proto
                 per_seed.append(r)
                 # every run builds a fresh Tuner => fresh jitted
                 # programs; without this the executable cache grows
@@ -352,6 +429,7 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
             rows.append({
                 "problem": prob, "mode": mode, "seeds": seeds,
                 "budget": budget, "sopts_sig": _sopts_sig(mode),
+                "proto": PROBLEM_PROTO.get(prob),
                 "median_iters": float(np.median(iters)),
                 "iqr": [float(np.percentile(iters, 25)),
                         float(np.percentile(iters, 75))],
@@ -395,14 +473,25 @@ def to_markdown(rows, seeds):
             f"| {r['problem']} | {r['mode']} | {r['median_iters']:.0f} "
             f"| {r['iqr'][0]:.0f}-{r['iqr'][1]:.0f} "
             f"| {r['censored']}/{r['seeds']} |")
-        ratios.setdefault(r["problem"], {})[r["mode"]] = r["median_iters"]
+        ratios.setdefault(r["problem"], {})[r["mode"]] = r
     lines += ["", "## Ratios (north star: surrogate <= 50% of baseline)",
-              ""]
+              "",
+              "Censored runs count at the full budget, which FLATTERS a",
+              "mode that censors more — so each ratio line also carries",
+              "the solve-rate (seeds that reached the threshold within",
+              "budget); read both together.", ""]
     for prob, m in ratios.items():
-        if "baseline" in m and "surrogate" in m and m["baseline"]:
-            ratio = m["surrogate"] / m["baseline"]
-            lines.append(f"* **{prob}**: {m['surrogate']:.0f} / "
-                         f"{m['baseline']:.0f} = **{ratio:.2f}**")
+        if "baseline" in m and "surrogate" in m \
+                and m["baseline"]["median_iters"]:
+            b, s = m["baseline"], m["surrogate"]
+            ratio = s["median_iters"] / b["median_iters"]
+            sr_s = s["seeds"] - s["censored"]
+            sr_b = b["seeds"] - b["censored"]
+            lines.append(
+                f"* **{prob}**: {s['median_iters']:.0f} / "
+                f"{b['median_iters']:.0f} = **{ratio:.2f}** "
+                f"(solve-rate surrogate {sr_s}/{s['seeds']}, "
+                f"baseline {sr_b}/{b['seeds']})")
     if any(r["censored"] for r in rows):
         lines += [
             "",
@@ -459,8 +548,8 @@ if __name__ == "__main__":
             for r in prior:
                 r["mode"] = _norm_mode(r["mode"])
         if args.quick and any(
-                r["problem"] in PROBLEMS
-                and r.get("budget") == int(PROBLEMS[r["problem"]]()[3])
+                r["problem"] in PROBLEM_BUDGETS
+                and r.get("budget") == PROBLEM_BUDGETS[r["problem"]]
                 for r in prior):
             # a --quick invocation must never displace full-budget rows
             # from the published rows file: half-budget aggregates would
@@ -488,10 +577,11 @@ if __name__ == "__main__":
             # the same staleness guards as the per-run state file:
             # never merge rows measured at another budget or under
             # other tpu-mode settings into the published table
-            cur_budget = (int(PROBLEMS[r["problem"]]()[3] * scale)
-                          if r["problem"] in PROBLEMS else None)
+            cur_budget = (int(PROBLEM_BUDGETS[r["problem"]] * scale)
+                          if r["problem"] in PROBLEM_BUDGETS else None)
             if (r.get("budget") != cur_budget
-                    or r.get("sopts_sig") != _sopts_sig(r["mode"])):
+                    or r.get("sopts_sig") != _sopts_sig(r["mode"])
+                    or r.get("proto") != PROBLEM_PROTO.get(r["problem"])):
                 dropped.append(r)
             else:
                 kept.append(r)

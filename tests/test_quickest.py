@@ -177,10 +177,15 @@ class TestAnalyze:
         early = rng.rand(50, 2).astype(np.float32) * 100
         impl = np.stack([early[:, 0] * 1.1 + 3,
                          rng.rand(50) * 100], 1).astype(np.float32)
-        out = hls_scores(early, impl, [("Registers", "Registers_used")],
+        out = hls_scores(early, impl,
+                         [("Registers", "Registers_used"),
+                          ("DSP", "Registers_used")],
                          ["Registers", "DSP"],
                          ["Registers_used", "DSP_used"])
-        assert out["Registers_used"]["R2"] > 0.9
+        # keyed by (feature, target): two early features scored against
+        # the same target both survive (ADVICE r3)
+        assert out[("Registers", "Registers_used")]["R2"] > 0.9
+        assert ("DSP", "Registers_used") in out
 
     def test_analyze_dispatch(self, fitted):
         import uptune_tpu as ut

@@ -284,3 +284,58 @@ def test_permutation_space_only():
     rnd = run_technique(tb.get_technique("PureRandom"), space, tour_len, 5)
     assert float(best_ga.qor) <= float(rnd.qor) * 1.05
     assert not tb.get_technique("RandomNelderMead").supports(space)
+
+
+def test_legacy_two_arg_credit_meta_still_works():
+    """A user MetaTechnique subclass written against the pre-r3 2-arg
+    credit() signature must not crash the driver: the signature is
+    inspected ONCE at construction (a FutureWarning — visible under
+    default filters, unlike DeprecationWarning) and the driver falls
+    back to the legacy call (ADVICE r3).  A TypeError raised INSIDE a
+    modern credit() must still propagate."""
+    import warnings
+
+    from uptune_tpu.driver.driver import Tuner
+    from uptune_tpu.techniques.bandit import MetaTechnique
+    from uptune_tpu.techniques.purerandom import PureRandom
+    from uptune_tpu.workloads import rosenbrock_objective, rosenbrock_space
+
+    class LegacyMeta(MetaTechnique):
+        def __init__(self):
+            super().__init__([PureRandom(name="a"), PureRandom(name="b")],
+                             name="legacy")
+            self.calls = 0
+
+        def select_order(self):
+            return list(self.techniques)
+
+        def credit(self, name, was_new_best):  # old signature, no kwargs
+            self.calls += 1
+
+    space = rosenbrock_space(2, -3.0, 3.0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        t = Tuner(space, rosenbrock_objective(2), seed=11,
+                  technique=LegacyMeta())
+    assert any(issubclass(x.category, FutureWarning) for x in w)
+    res = t.run(test_limit=60)
+    t.close()
+    assert t.root.calls > 0
+    assert res.best_qor < float("inf")
+
+    class BuggyModernMeta(MetaTechnique):
+        def __init__(self):
+            super().__init__([PureRandom(name="a")], name="buggy")
+
+        def select_order(self):
+            return list(self.techniques)
+
+        def credit(self, name, was_new_best, step_best=None,
+                   global_best=None):
+            raise TypeError("bug inside a modern credit()")
+
+    t2 = Tuner(space, rosenbrock_objective(2), seed=12,
+               technique=BuggyModernMeta())
+    with pytest.raises(TypeError, match="bug inside"):
+        t2.run(test_limit=60)
+    t2.close()

@@ -207,6 +207,31 @@ class Tuner:
             import copy
             root = copy.deepcopy(root)
         self.root: Technique = root
+        # MetaTechnique.credit grew step_best=/global_best= keywords in
+        # r3; a user subclass written against the old 2-arg signature
+        # must keep working.  Detect ONCE by inspection — catching
+        # TypeError at call time would misread a genuine TypeError
+        # raised INSIDE a modern credit() as a legacy signature
+        # (ADVICE r3 / r4 review).
+        self._credit_kw = True
+        if isinstance(root, MetaTechnique):
+            import inspect
+            try:
+                ps = inspect.signature(root.credit).parameters.values()
+            except (TypeError, ValueError):  # builtins/C: assume modern
+                ps = ()
+            if ps and not any(
+                    p.name == "step_best"
+                    or p.kind == inspect.Parameter.VAR_KEYWORD
+                    for p in ps):
+                self._credit_kw = False
+                import warnings
+                warnings.warn(
+                    f"{type(root).__name__}.credit uses the legacy "
+                    "(name, was_new_best) signature; add step_best= "
+                    "and global_best= keywords — quality-aware metas "
+                    "(RecyclingMeta) need them. Falling back to the "
+                    "2-arg call.", FutureWarning)
         members = (root.techniques if isinstance(root, MetaTechnique)
                    else [root])
         self.members: List[Technique] = [
@@ -300,6 +325,7 @@ class Tuner:
         i.e. without touching technique states)."""
         rows = []
         sig = None
+        compacted = 0
         good_end = 0
         size = os.path.getsize(path)
         with open(path, "rb") as f:
@@ -316,6 +342,11 @@ class Tuner:
                     break  # complete JSON but unterminated final line
                 if "space_sig" in rec:
                     sig = rec["space_sig"]
+                    # ut-stats --compact records how many duplicate rows
+                    # it dropped; without this the resumed evals count
+                    # would shrink and test_limit budgets would re-spend
+                    # the difference in real evaluations
+                    compacted = int(rec.get("compacted_rows", 0))
                 else:
                     rows.append(rec)
                 good_end = f.tell()
@@ -356,8 +387,8 @@ class Tuner:
                 np.asarray(self.space.features(cands)), qor)
             self.surrogate.maybe_refit()
         self.gid = max(int(r["gid"]) for r in rows) + 1
-        self.evals = len(rows)
-        self.told = len(rows)
+        self.evals = len(rows) + compacted
+        self.told = len(rows) + compacted
         running = float("inf")
         for q in qor:
             running = min(running, float(q))
@@ -684,8 +715,12 @@ class Tuner:
                 # and dodge recycling
                 step_best = min((tr.qor for tr in live),
                                 default=float("inf"))
-                self.root.credit(tk.arm.name, was_new_best,
-                                 step_best=step_best, global_best=new)
+                if self._credit_kw:
+                    self.root.credit(tk.arm.name, was_new_best,
+                                     step_best=step_best,
+                                     global_best=new)
+                else:
+                    self.root.credit(tk.arm.name, was_new_best)
                 # quality-aware metas (RecyclingMeta) may ask for member
                 # restarts: re-initialize the member's device state (the
                 # jitted programs are keyed by name and stay cached)

@@ -69,21 +69,24 @@ def hls_scores(x: np.ndarray, y: np.ndarray,
     """The no-model baseline (analyze_scores_hls, analyze.py:293-333):
     score an early HLS feature DIRECTLY as the prediction of its
     post-implementation counterpart — the floor any learned estimator
-    must beat.  `pairs` maps (feature_name, target_name)."""
+    must beat.  `pairs` maps (feature_name, target_name); the result is
+    keyed by (feature, target) so two early features scored against the
+    same target both survive (the reference emits one row per pair)."""
     x = np.atleast_2d(np.asarray(x, np.float32))
     y = np.atleast_2d(np.asarray(y, np.float32))
-    out: Dict[str, Dict[str, float]] = {}
+    out: Dict[tuple, Dict[str, float]] = {}
     for feat, tgt in pairs:
         fi = list(feature_names).index(feat)
         ti = list(target_names).index(tgt)
         fx, ty = x[:, fi], y[:, ti]
-        out[tgt] = {"feature": feat, "RAE": rae(ty, fx),
-                    "R2": r2_score(ty, fx), "RRSE": rrse(ty, fx)}
+        out[(feat, tgt)] = {"feature": feat, "target": tgt,
+                            "RAE": rae(ty, fx),
+                            "R2": r2_score(ty, fx), "RRSE": rrse(ty, fx)}
     if save_dir:
         _write_table(os.path.join(save_dir, "scores_hls.csv"),
                      ["target", "feature", "RAE", "R2", "RRSE"],
-                     [[t, m["feature"], m["RAE"], m["R2"], m["RRSE"]]
-                      for t, m in out.items()])
+                     [[m["target"], m["feature"], m["RAE"], m["R2"],
+                       m["RRSE"]] for m in out.values()])
     return out
 
 
