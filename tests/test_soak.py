@@ -1,0 +1,95 @@
+"""Long-run soak (VERDICT r3 next-step #4): one 10^5-eval run through
+the real Tuner exercising oldest-first History eviction, the surfaced
+`dropped` counter, archive growth, torn-tail kill + resume, the dedup
+floor past 2× capacity, and `ut-stats --compact` (the compactdb.py
+equivalent) — end to end on one archive file.
+"""
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from uptune_tpu.driver.driver import Tuner  # noqa: E402
+from uptune_tpu.space.params import IntParam  # noqa: E402
+from uptune_tpu.space.spec import Space  # noqa: E402
+from uptune_tpu.utils.stats import (FollowAccumulator,  # noqa: E402
+                                    compact_archive, load_archive,
+                                    technique_report)
+
+CAP = 1 << 12          # history capacity: 4096 << eval count
+
+
+def _space():
+    return Space([IntParam(f"x{i}", 0, 31) for i in range(8)])
+
+
+def _objective(cfgs):
+    # cheap separable bowl with a known optimum at x=7: keeps the run
+    # improving slowly enough that techniques stay active all soak
+    out = []
+    for c in cfgs:
+        out.append(float(sum((c[f"x{i}"] - 7) ** 2 for i in range(8))))
+    return np.asarray(out)
+
+
+@pytest.mark.slow
+class TestSoak:
+    def test_soak_eviction_resume_compact(self, tmp_path):
+        arch = str(tmp_path / "soak.jsonl")
+
+        # phase 1: 50k evals, then die WITHOUT close() — plus a torn
+        # half-line, the on-disk state a SIGKILL mid-write leaves
+        t = Tuner(_space(), _objective, seed=0, capacity=CAP,
+                  archive=arch)
+        t.run(test_limit=50_000)
+        evals1 = t.evals
+        best1 = t.result().best_qor
+        dropped1 = int(t.hist_state.dropped)
+        assert evals1 >= 50_000
+        # 50k novel evals through a 4k history => eviction MUST have
+        # happened and the counter must surface it (>= evals - capacity
+        # would be exact if every insert was novel; stay conservative)
+        assert dropped1 > 2 * CAP, dropped1
+        t._flush_archive()
+        t._archive_f.write('{"gid": 99999999, "tech": "torn')  # no \n
+        t._archive_f.flush()
+        del t
+
+        # phase 2: resume repairs the torn tail and replays 50k rows
+        t2 = Tuner(_space(), _objective, seed=1, capacity=CAP,
+                   archive=arch, resume=True)
+        assert t2.evals == evals1, (t2.evals, evals1)
+        assert t2.result().best_qor <= best1 + 1e-9
+        t2.run(test_limit=100_000)
+        assert t2.evals >= 100_000
+        assert int(t2.hist_state.dropped) > dropped1
+        t2.close()
+
+        rows = load_archive(arch)
+        assert len(rows) >= 100_000
+        # dedup floor past 2x capacity: the archive stays dominated by
+        # distinct configs (re-evals of evicted configs are allowed,
+        # wholesale duplicate churn is not)
+        uniq = len({json.dumps([r["u"], r["perms"]]) for r in rows})
+        assert uniq / len(rows) > 0.8, (uniq, len(rows))
+
+        # incremental --follow fold at 10^5 rows (VERDICT r3 weak #6):
+        # chunked folding must agree with the full recompute
+        acc = FollowAccumulator("min")
+        for i in range(0, len(rows), 4096):
+            acc.update(rows[i:i + 4096])
+        assert acc.snapshot() == technique_report(rows)
+
+        # compaction drops only duplicate-config rows, atomically
+        st = compact_archive(arch)
+        assert st["rows_after"] == uniq
+        assert st["rows_before"] == len(rows)
+
+        # a tuner resumed from the COMPACTED archive reconstructs the
+        # same best (replay only needed each config once)
+        t3 = Tuner(_space(), _objective, seed=2, capacity=CAP,
+                   archive=arch, resume=True)
+        assert abs(t3.result().best_qor - t2.result().best_qor) < 1e-9
+        t3.close()

@@ -155,3 +155,151 @@ class TestFollow:
         assert rc == 0
         out = capsys.readouterr().out
         assert "evals=2" in out and "best=4" in out and "DE" in out
+
+
+class TestCompareMode:
+    """Cross-run technique comparison (stats_matplotlib.py equivalent,
+    VERDICT r3 next-step #6): multiple archives -> per-technique median
+    best-so-far."""
+
+    def _mk(self, tmp_path, name, rows):
+        p = tmp_path / name
+        with open(p, "w") as f:
+            f.write(json.dumps({"space_sig": "x"}) + "\n")
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        return str(p)
+
+    @staticmethod
+    def _row(tech, qor):
+        return {"tech": tech, "qor": qor, "time": 0.0}
+
+    def test_median_across_runs(self, tmp_path):
+        from uptune_tpu.utils.stats import compare_convergence
+        a = [self._row("t", 10.0), self._row("t", 4.0)]
+        b = [self._row("t", 8.0), self._row("t", 6.0)]
+        c = [self._row("t", 2.0), self._row("t", 9.0)]
+        conv = compare_convergence([a, b, c])
+        pts = dict((int(i), v) for i, v in conv["t"])
+        # at eval 0 best-so-fars are 10/8/2 -> median 8;
+        # at eval 1 they are 4/6/2 -> median 4
+        assert pts[0] == 8.0
+        assert pts[1] == 4.0
+
+    def test_technique_absent_from_one_run(self, tmp_path):
+        from uptune_tpu.utils.stats import compare_convergence
+        a = [self._row("t", 5.0), self._row("u", 3.0)]
+        b = [self._row("t", 7.0), self._row("t", 1.0)]
+        conv = compare_convergence([a, b])
+        assert "u" in conv    # present in only one run still plotted
+        assert conv["u"][0][1] == 3.0
+
+    def test_cli_multi_archive(self, tmp_path, capsys):
+        from uptune_tpu.utils.stats import main as stats_main
+        p1 = self._mk(tmp_path, "a.jsonl",
+                      [self._row("t", 5.0), self._row("u", 3.0)])
+        p2 = self._mk(tmp_path, "b.jsonl",
+                      [self._row("t", 2.0)])
+        csv = tmp_path / "cmp.csv"
+        rc = stats_main([p1, p2, "--csv", str(csv)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "cross-run comparison over 2 archives" in out
+        assert "median_best_so_far" in csv.read_text()
+
+    def test_follow_accumulator_matches_full_recompute(self, archive):
+        """The incremental fold must reproduce technique_report exactly
+        (VERDICT r3 weak #6), fed in uneven chunks."""
+        from uptune_tpu.utils.stats import FollowAccumulator
+        rows = load_archive(archive)
+        acc = FollowAccumulator("min")
+        i = 0
+        for sz in (1, 7, 31, 64, 1000):
+            acc.update(rows[i:i + sz])
+            i += sz
+        acc.update(rows[i:])
+        full = technique_report(rows)
+        assert acc.snapshot() == full
+
+
+def test_compact_archive_dedups_and_guards(tmp_path):
+    """--compact keeps the header + first row per config, and ABORTS if
+    the archive grows mid-compaction (a live tuner appending would keep
+    writing to the replaced inode — rows would vanish silently)."""
+    from uptune_tpu.utils.stats import compact_archive
+    p = tmp_path / "a.jsonl"
+    rows = [{"gid": 0, "tech": "t", "qor": 1.0, "u": [0.1], "perms": []},
+            {"gid": 1, "tech": "t", "qor": 2.0, "u": [0.2], "perms": []},
+            {"gid": 2, "tech": "u", "qor": 1.0, "u": [0.1], "perms": []}]
+    with open(p, "w") as f:
+        f.write(json.dumps({"space_sig": "s"}) + "\n")
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        f.write('{"torn')
+    st = compact_archive(str(p))
+    assert st == {"rows_before": 3, "rows_after": 2}
+    kept = [json.loads(l) for l in open(p)]
+    assert "space_sig" in kept[0]
+    assert [r["gid"] for r in kept[1:]] == [0, 1]  # first dup wins
+
+    # live-writer guard: grow the file between read and replace by
+    # monkeypatching getsize is overkill — simulate with an appender
+    import os as _os
+    import uptune_tpu.utils.stats as stats_mod
+    real_getsize = _os.path.getsize
+    calls = {"n": 0}
+
+    def growing(path_):
+        calls["n"] += 1
+        return real_getsize(path_) + (0 if calls["n"] == 1 else 64)
+
+    stats_mod.os.path.getsize = growing
+    try:
+        with pytest.raises(RuntimeError, match="grew while compacting"):
+            compact_archive(str(p))
+    finally:
+        stats_mod.os.path.getsize = real_getsize
+    # aborted compaction left the archive untouched
+    assert [json.loads(l) for l in open(p)] == kept
+
+
+def test_compare_convergence_carries_finished_runs_forward():
+    """A short (target-hit) run keeps contributing its final best to
+    later grid points — the median best-so-far must never regress when
+    a run ends (r4 review finding)."""
+    from uptune_tpu.utils.stats import compare_convergence
+    short = [{"tech": "t", "qor": 1.0}]
+    long_ = [{"tech": "t", "qor": 100.0} for _ in range(50)]
+    conv = compare_convergence([short, long_])
+    vals = [v for _, v in conv["t"]]
+    # median of (1.0 carried, 100.0) stays 50.5 to the end — no jump up
+    assert all(abs(v - 50.5) < 1e-9 for v in vals), vals
+    assert vals == sorted(vals, reverse=True) or len(set(vals)) == 1
+
+
+def test_compacted_archive_preserves_eval_budget(tmp_path):
+    """Resume after --compact must not shrink evals/told: the dropped
+    duplicate rows' budget would otherwise be re-spent in real
+    evaluations (r4 review finding)."""
+    from uptune_tpu.utils.stats import compact_archive
+    space = Space([FloatParam("x", -1.0, 1.0)])
+
+    def obj(cfgs):
+        return [c["x"] ** 2 for c in cfgs]
+
+    arch = str(tmp_path / "a.jsonl")
+    t = Tuner(space, obj, seed=0, archive=arch)
+    t.run(test_limit=300)
+    evals0, best0 = t.evals, t.result().best_qor
+    t.close()
+    st = compact_archive(arch)
+    assert st["rows_before"] >= st["rows_after"]
+    t2 = Tuner(space, obj, seed=1, archive=arch, resume=True)
+    assert t2.evals == evals0, (t2.evals, evals0)
+    assert abs(t2.result().best_qor - best0) < 1e-9
+    # a second compaction accumulates the counter instead of resetting
+    t2.close()
+    st2 = compact_archive(arch)
+    t3 = Tuner(space, obj, seed=2, archive=arch, resume=True)
+    assert t3.evals >= evals0
+    t3.close()
