@@ -29,9 +29,17 @@ def main() -> int:
     from uptune_tpu.parallel import (initialize, is_coordinator,
                                      make_multihost_mesh)
     cfg = initialize()           # from UT_COORDINATOR / UT_* env
+    import json
+
     import jax
+    import jax.numpy as jnp
     import numpy as np
     from jax.experimental import multihost_utils
+
+    steps = int(os.environ.get("UT_MH_STEPS", "25"))
+    ckpt = os.environ.get("UT_MH_CKPT")          # write best here
+    resume = os.environ.get("UT_MH_RESUME") == "1"   # ...or restore it
+    start_file = os.environ.get("UT_MH_START_FILE")  # liveness beacon
 
     assert jax.process_count() == cfg["num_processes"], (
         jax.process_count(), cfg)
@@ -51,23 +59,66 @@ def main() -> int:
     eng = FusedEngine(space, lambda v, p: sphere_device(v),
                       arms=default_arms(1), history_capacity=1 << 10)
     se = ShardedEngine(eng, mesh)
-    state = se.init(jax.random.PRNGKey(0))
-    state = se.run(state, 25)
+    # the seed must be IDENTICAL on every process: ShardedEngine.init
+    # builds one global sharded state, and multihost device_put asserts
+    # the same global value on each process.  Per-REPLICA divergence
+    # (the uneven best distribution the exchange collective must
+    # reconcile) comes from the jax.random.split over the search axis
+    # inside init() — each of the n_search replicas draws its own key.
+    state = se.init(jax.random.PRNGKey(1000))
+
+    restored = None
+    if resume and ckpt and os.path.exists(ckpt):
+        # pod-preemption recovery, the TPU-native failure model: the job
+        # died as a unit (a host was SIGKILLed), restarted, and resumes
+        # from the checkpointed global best instead of from scratch
+        with open(ckpt) as f:
+            saved = json.load(f)
+        restored = float(saved["qor"])
+        n_search = mesh.shape["search"]
+        u = jnp.asarray(saved["u"], jnp.float32)
+        best = state.best.__class__(
+            jnp.tile(u[None, :], (n_search, 1)),
+            state.best.perms,
+            jnp.full((n_search,), restored, jnp.float32))
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("search"))
+        best = jax.tree.map(lambda x: jax.device_put(x, sharding), best)
+        state = state._replace(best=best)
+
+    if start_file:       # tell the parent we are alive and mid-phase
+        with open(start_file, "w") as f:
+            f.write(str(os.getpid()))
+
+    state = se.run(state, steps)
     jax.block_until_ready(state)
 
     # per-replica bests live sharded across hosts: allgather to every
     # process, then each computes the same global answer
     qors = multihost_utils.process_allgather(state.best.qor, tiled=True)
     qors = np.asarray(qors).reshape(-1)
+    us = np.asarray(multihost_utils.process_allgather(
+        state.best.u, tiled=True)).reshape(qors.shape[0], -1)
     gbest = float(qors.min())
     # every replica already holds the exchanged global best (the
     # per-step _exchange collective), so all replica bests must agree
     spread = float(qors.max() - qors.min())
+    if ckpt and not resume and is_coordinator():
+        tmp = ckpt + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"qor": gbest,
+                       "u": us[int(qors.argmin())].tolist()}, f)
+        os.replace(tmp, ckpt)
     print(f"UT_MH pid={cfg['process_id']} coord={is_coordinator()} "
           f"replicas={qors.shape[0]} global_best={gbest:.9f} "
-          f"spread={spread:.3e}", flush=True)
+          f"spread={spread:.3e} restored="
+          f"{'-' if restored is None else f'{restored:.9f}'}", flush=True)
     assert spread < 1e-6, f"replicas disagree after exchange: {qors}"
     assert gbest < 1.0, f"sharded engine failed to descend: {gbest}"
+    if restored is not None:
+        # resumed search must start from (and never regress past) the
+        # checkpointed best
+        assert gbest <= restored + 1e-9, (gbest, restored)
     return 0
 
 

@@ -106,6 +106,108 @@ def _free_port() -> int:
     return port
 
 
+def _spawn_workers(n, port, extra_env, worker=None):
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    worker = worker or os.path.join(os.path.dirname(__file__),
+                                    "multihost_worker.py")
+    procs = []
+    for pid in range(n):
+        env = dict(
+            env_base,
+            JAX_PLATFORMS="cpu",
+            UT_COORDINATOR=f"localhost:{port}",
+            UT_NUM_PROCESSES=str(n),
+            UT_PROCESS_ID=str(pid),
+            **{k: (v.format(pid=pid) if isinstance(v, str) else v)
+               for k, v in extra_env.items()},
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    return procs
+
+
+@pytest.mark.slow
+class TestFourProcessElastic:
+    """VERDICT r3 next-step #5: 4 jax.distributed processes × 2 devices
+    with per-process seed offsets (uneven best trajectories), a
+    checkpointed best, a SIGKILLed worker mid-phase (pod preemption —
+    the TPU failure model is job-level restart, not MPI-style membership
+    change), and a resumed 4-process job that restores the checkpoint
+    and never regresses past it."""
+
+    def test_kill_and_resume_over_dcn(self, tmp_path):
+        import time as _time
+        ckpt = str(tmp_path / "best.json")
+
+        # phase A: clean 4-proc run, uneven seeds, writes the checkpoint
+        procs = _spawn_workers(4, _free_port(), {"UT_MH_CKPT": ckpt})
+        outs = _communicate_all(procs, timeout=600)
+        bests = set()
+        coords = 0
+        for out in outs:
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith("UT_MH "))
+            bests.add(line.split("global_best=")[1].split()[0])
+            coords += "coord=True" in line
+        assert len(bests) == 1, outs     # all 4 agree after exchange
+        assert coords == 1, outs         # exactly one coordinator
+        assert os.path.exists(ckpt)
+        import json as _json
+        with open(ckpt) as f:
+            saved = _json.load(f)
+        assert saved["qor"] < 1.0
+
+        # phase B: same job, long-running; SIGKILL one worker mid-phase,
+        # then tear down the rest (the job dies as a unit — preemption)
+        beacon = str(tmp_path / "started_{pid}.txt")
+        procs = _spawn_workers(4, _free_port(), {
+            "UT_MH_STEPS": "4000",
+            "UT_MH_START_FILE": beacon,
+        })
+        deadline = _time.time() + 420
+        while _time.time() < deadline and not all(
+                os.path.exists(beacon.format(pid=p)) for p in range(4)):
+            _time.sleep(0.5)
+        assert all(os.path.exists(beacon.format(pid=p))
+                   for p in range(4)), "phase B never got under way"
+        procs[2].kill()                       # the preempted host
+        rc2 = procs[2].wait(timeout=60)
+        assert rc2 != 0
+        for p in procs:                       # job-level teardown
+            p.kill()
+            p.wait(timeout=60)
+
+        # phase C: restart the whole 4-proc job with resume: it restores
+        # the phase-A best and must end at-or-below it, all agreeing
+        procs = _spawn_workers(4, _free_port(), {
+            "UT_MH_CKPT": ckpt, "UT_MH_RESUME": "1"})
+        outs = _communicate_all(procs, timeout=600)
+        finals = set()
+        for out in outs:
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith("UT_MH "))
+            assert f"restored={saved['qor']:.9f}" in line, line
+            finals.add(float(line.split("global_best=")[1].split()[0]))
+        assert len(finals) == 1
+        assert finals.pop() <= saved["qor"] + 1e-9
+
+
+def _communicate_all(procs, timeout):
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker hung")
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        outs.append(out)
+    return outs
+
+
 class TestMesh:
     def test_layout(self):
         mesh = make_multihost_mesh(n_eval_per_host=2)
@@ -128,3 +230,64 @@ class TestMesh:
 
     def test_coordinator_predicate(self):
         assert is_coordinator() is True   # single-process run
+
+
+class TestLauncher:
+    def test_num_hosts_spawns_prefixed_children(self, capsys):
+        """`ut --num-hosts 2 ...` runs the same command in 2 local
+        processes with the UT_* distributed env wired (the cluster
+        provisioning analogue, cluster/config.yaml)."""
+        from uptune_tpu.cli import main as cli_main
+        rc = cli_main(["--num-hosts", "2", "--list-techniques"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[h0] PureRandom" in out
+        assert "[h1] PureRandom" in out
+
+    def test_child_does_not_relaunch(self, monkeypatch, capsys):
+        """A child (UT_PROCESS_ID set) must run the command itself, not
+        fork another fleet."""
+        monkeypatch.setenv("UT_PROCESS_ID", "0")
+        from uptune_tpu.cli import main as cli_main
+        rc = cli_main(["--num-hosts", "2", "--list-techniques"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "[h0]" not in out and "PureRandom" in out
+
+
+@pytest.mark.slow
+class TestLauncherTune:
+    def test_two_replica_program_tune(self, tmp_path):
+        """`ut --num-hosts 2 prog.py`: replicas diverge (per-replica
+        seed), write separate archives/bests (no shared-file races on
+        one work_dir — slot sandboxes are namespaced per replica), and
+        the launcher promotes the winner to best.json (r4 review: the
+        plumbing-only test missed all of this)."""
+        import json as _json
+        import shutil
+
+        src = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "samples", "rosenbrock",
+            "rosenbrock.py")
+        prog = tmp_path / "rosenbrock.py"
+        shutil.copy(src, prog)
+        env = dict(os.environ)
+        env.pop("UT_PROCESS_ID", None)
+        env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-m", "uptune_tpu.cli", str(prog),
+             "--num-hosts", "2", "--test-limit", "20"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "best across 2 replicas" in r.stdout
+        arch0 = tmp_path / "ut.archive.jsonl"
+        arch1 = tmp_path / "ut.archive.h1.jsonl"
+        assert arch0.exists() and arch1.exists()
+        best = _json.load(open(tmp_path / "best.json"))
+        bests = [best["qor"]]
+        if (tmp_path / "best.h1.json").exists():
+            bests.append(_json.load(open(tmp_path / "best.h1.json"))["qor"])
+        # the promoted best.json is the min across replica bests
+        assert best["qor"] == min(bests)

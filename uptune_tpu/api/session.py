@@ -88,7 +88,12 @@ def get_best(work_dir: Optional[str] = None) -> Tuple[Dict[str, Any], Any]:
 
 
 def write_best(cfg: Dict[str, Any], qor: Any,
-               work_dir: Optional[str] = None) -> None:
-    """Controller-side write of best.json (api.py:146-149)."""
-    with open(best_path(work_dir), "w") as f:
+               work_dir: Optional[str] = None,
+               filename: Optional[str] = None) -> None:
+    """Controller-side write of best.json (api.py:146-149).  `filename`
+    overrides BEST_FILE (multi-host replicas write best.h{N}.json so N
+    processes never race on one file)."""
+    path = (os.path.join(work_dir or STATE.work_dir, filename)
+            if filename else best_path(work_dir))
+    with open(path, "w") as f:
         json.dump({"config": cfg, "qor": qor}, f, indent=1)

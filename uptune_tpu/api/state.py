@@ -95,9 +95,14 @@ class _ProtocolState:
         stage.append(rec)
 
     def flush_params(self) -> None:
+        # atomic (tmp + rename): multi-host replicas may run their
+        # analysis passes concurrently in one work_dir; a torn
+        # ut.params.json read by the sibling would crash its space build
         path = os.path.join(self.work_dir, PARAMS_FILE)
-        with open(path, "w") as f:
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(self.recorded, f, indent=1)
+        os.replace(tmp, path)
 
     # ------------------------------------------------------------------
     # TUNE side
@@ -187,9 +192,11 @@ class _ProtocolState:
 
     def write_default_qor(self, value: Any, trend: str) -> None:
         path = os.path.join(self.work_dir, DEFAULT_QOR_FILE)
-        with open(path, "w") as f:
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump({"qor": value, "trend": trend,
                        "stage": self.cur_stage}, f)
+        os.replace(tmp, path)
 
 
 STATE = _ProtocolState()

@@ -69,6 +69,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="analyze, print the param records and exit")
     p.add_argument("--cfg", action="store_true",
                    help="print the resolved configuration")
+    p.add_argument("--num-hosts", type=int, default=None,
+                   help="run the same command in N local processes (the "
+                        "analogue of the reference's Ray cluster "
+                        "provisioning, cluster/config.yaml). In program "
+                        "mode each process is an INDEPENDENT search "
+                        "replica (multi-start: seeds diverge, replica "
+                        "i>0 writes ut.archive.hi.jsonl / best.hi.json; "
+                        "the launcher promotes the best replica to "
+                        "best.json at the end). The UT_COORDINATOR / "
+                        "UT_NUM_PROCESSES / UT_PROCESS_ID env is also "
+                        "wired, so library-mode programs can call "
+                        "uptune_tpu.parallel.initialize() for the "
+                        "jax.distributed sharded-engine plane")
     p.add_argument("--device", choices=("cpu", "accel"), default="cpu",
                    help="platform for the search engine (default cpu: "
                         "black-box evals dominate; 'accel' trusts the "
@@ -83,10 +96,140 @@ def _configure_logging(verbose: bool) -> None:
         format="[%(relativeCreated)7.0fms] %(levelname)s %(message)s")
 
 
+def _launch_hosts(n: int, argv: Optional[List[str]],
+                  work_dir: Optional[str] = None) -> int:
+    """`ut --num-hosts N ...`: run the SAME ut command in N local
+    processes — the single-machine analogue of the reference's cluster
+    provisioning (cluster/config.yaml spins Ray head + workers).  On a
+    real pod each host runs the same command with UT_COORDINATOR
+    pointing at host 0; this flag exists so the multi-process path can
+    be exercised anywhere.
+
+    PROGRAM-mode semantics are multi-start: each replica tunes
+    independently with a diverged seed and its own archive/best files
+    (ProgramTuner.host_tag), and the launcher promotes the best
+    replica's result to best.json afterwards — there is no cross-host
+    exchange in the subprocess evaluation plane.  The jax.distributed
+    coordinator env is still wired for library-mode programs that build
+    the sharded engine (parallel/ is the plane with real ICI/DCN
+    collectives).
+
+    Children inherit everything else from the parent command line; their
+    output is line-prefixed with [hN].  Exit code is the first nonzero
+    child code."""
+    import socket
+    import subprocess
+    import threading
+
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    base = [a for a in (argv if argv is not None else sys.argv[1:])]
+    # strip the flag (both --num-hosts N and --num-hosts=N spellings)
+    cleaned, skip = [], False
+    for a in base:
+        if skip:
+            skip = False
+            continue
+        if a == "--num-hosts":
+            skip = True
+            continue
+        if a.startswith("--num-hosts="):
+            continue
+        cleaned.append(a)
+
+    # children must import uptune_tpu regardless of their cwd (checkout
+    # use without pip install -e — same seam as ProgramTuner.env_extra)
+    from .utils.pypath import child_pythonpath
+    pp = child_pythonpath()
+    procs = []
+    for pid in range(n):
+        env = dict(os.environ,
+                   PYTHONPATH=pp,
+                   UT_COORDINATOR=f"localhost:{port}",
+                   UT_NUM_PROCESSES=str(n),
+                   UT_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "uptune_tpu.cli", *cleaned], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+
+    def _pump(i, p):
+        for line in p.stdout:
+            sys.stdout.write(f"[h{i}] {line}")
+            sys.stdout.flush()
+
+    threads = [threading.Thread(target=_pump, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+    rc = 0
+    for p in procs:
+        code = p.wait()
+        rc = rc or code
+    for t in threads:
+        t.join(timeout=5)
+    _merge_replica_bests(cleaned, n, work_dir)
+    return rc
+
+
+def _merge_replica_bests(cleaned: List[str], n: int,
+                         work_dir: Optional[str] = None) -> None:
+    """Promote the best replica's result to best.json (best-effort: the
+    work dir is the launcher's --work-dir when given, else derived from
+    the script positional — matching main()'s own resolution; silently
+    skipped for non-tuning invocations like --list-techniques)."""
+    import json as _json
+
+    script = next((a for a in cleaned
+                   if not a.startswith("-") and os.path.isfile(a)
+                   and a.endswith((".py", ".tpl"))), None)
+    if script is None:
+        return
+    if work_dir:
+        work_dir = os.path.abspath(work_dir)
+    else:
+        work_dir = os.path.dirname(os.path.abspath(script)) or os.getcwd()
+    # orientation comes from the program's declared trend (ut.target)
+    sense = "min"
+    try:
+        with open(os.path.join(work_dir, "ut.default_qor.json")) as f:
+            sense = _json.load(f).get("trend", "min")
+    except (OSError, ValueError):
+        pass
+    sign = 1.0 if sense == "min" else -1.0
+    cands = []
+    for pid in range(n):
+        tag = f".h{pid}" if pid else ""
+        path = os.path.join(work_dir, f"best{tag}.json")
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path) as f:
+                rec = _json.load(f)
+            cands.append((sign * float(rec["qor"]), pid, rec))
+        except (ValueError, KeyError, OSError):
+            continue
+    if not cands:
+        return
+    skey, pid, rec = min(cands)
+    qor = sign * skey
+    dst = os.path.join(work_dir, "best.json")
+    if pid != 0:
+        with open(dst, "w") as f:
+            _json.dump(rec, f, indent=1)
+    print(f"[ut] best across {len(cands)} replicas: qor={qor:.6g} "
+          f"(replica h{pid}) -> {dst}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     _configure_logging(args.verbose)
     log = logging.getLogger("uptune_tpu")
+    if args.num_hosts is not None and args.num_hosts > 1 \
+            and "UT_PROCESS_ID" not in os.environ:
+        return _launch_hosts(args.num_hosts, argv, args.work_dir)
     if args.device == "cpu":
         # the proposal engine is cheap next to black-box evals; default
         # to the (hang-proof) host platform unless --device accel

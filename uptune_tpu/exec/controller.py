@@ -90,9 +90,22 @@ class ProgramTuner:
         self.technique = (technique if technique is not None
                           else settings["technique"])
         self.seed = int(seed if seed is not None else settings["seed"])
+        # `ut --num-hosts N` (or a real pod launch) makes each process
+        # an INDEPENDENT search replica over the same program
+        # (multi-start): program-mode tuning has no cross-process
+        # exchange — the jax.distributed sharded-engine plane is the
+        # library-mode story (parallel/).  Diverge the replica seeds,
+        # and give non-coordinator replicas their own archive/best
+        # files so N appenders never interleave one jsonl (compare
+        # afterwards with `ut-stats ut.archive*.jsonl`).
+        pid = int(os.environ.get("UT_PROCESS_ID", "0") or 0)
+        nproc = int(os.environ.get("UT_NUM_PROCESSES", "1") or 1)
+        self.host_tag = f".h{pid}" if (nproc > 1 and pid > 0) else ""
+        if nproc > 1:
+            self.seed += pid
         self.params_file = params_file
         self.archive = archive if archive is not None else os.path.join(
-            self.work_dir, "ut.archive.jsonl")
+            self.work_dir, f"ut.archive{self.host_tag}.jsonl")
         self.resume = resume
         if surrogate is None:
             # same flags > ut.config() > defaults layering as the
@@ -116,6 +129,12 @@ class ProgramTuner:
         else:
             self.surrogate_opts = surrogate_opts
         self.env_extra = dict(env or {})
+        # children (analysis run + sandboxed eval workers) must be able
+        # to `import uptune_tpu` even from a plain checkout with no
+        # `pip install -e .` (utils/pypath.py)
+        from ..utils.pypath import child_pythonpath
+        self.env_extra["PYTHONPATH"] = child_pythonpath(
+            self.env_extra.get("PYTHONPATH"))
         self.use_sandbox = sandbox
         self.status_interval = (status_interval if status_interval
                                 is not None else max(1, self.parallel))
@@ -206,7 +225,9 @@ class ProgramTuner:
         if stats is not None and stats.was_new_best:
             res = self.tuner.result()
             write_best(res.best_config, res.best_qor,
-                       work_dir=self.work_dir)
+                       work_dir=self.work_dir,
+                       filename=(f"best{self.host_tag}.json"
+                                 if self.host_tag else None))
             log.info("[ut] new best qor=%.6g after %d evals",
                      res.best_qor, res.evals)
 
@@ -278,7 +299,13 @@ class ProgramTuner:
                         runtime_limit=self.runtime_limit,
                         env=self.env_extra,
                         sandbox=self.use_sandbox,
-                        pre_launch=pre_launch) as pool:
+                        pre_launch=pre_launch,
+                        # multi-host replicas share work_dir: namespace
+                        # the sandbox slots (and thereby the per-slot
+                        # config hand-off files) per replica, or two
+                        # replicas' workers read each other's configs
+                        slot_prefix=(f"{self.host_tag[1:]}."
+                                     if self.host_tag else "")) as pool:
             self.pool = pool
             while True:
                 # gate on told (per-trial), not evals (per-ticket): a

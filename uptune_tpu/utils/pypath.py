@@ -1,0 +1,28 @@
+"""Child-process import seam: make `import uptune_tpu` work in spawned
+subprocesses (analysis runs, sandboxed eval workers, --num-hosts fleet
+members) even from a plain checkout with no `pip install -e .`.
+
+For an installed package the computed directory is site-packages —
+already importable, so the entry is inert.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def pkg_parent_dir() -> str:
+    """Directory CONTAINING the uptune_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def child_pythonpath(existing: Optional[str] = None) -> str:
+    """PYTHONPATH value for a child process: the package parent dir
+    prepended to `existing` (defaults to the current environment's)."""
+    pp = (os.environ.get("PYTHONPATH", "")
+          if existing is None else existing)
+    root = pkg_parent_dir()
+    if root in pp.split(os.pathsep):
+        return pp
+    return root + (os.pathsep + pp if pp else "")
