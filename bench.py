@@ -200,7 +200,8 @@ def main() -> None:
     total_flops, total_bytes = _cost_analysis(compiled)
 
     rep_times = []
-    reps = 1 if quick else 3
+    reps = 3  # 3 reps even at quick size: rounds are only comparable if
+    # the artifact carries per-rep variance (VERDICT r3 weak #1)
     for _ in range(reps):
         s = eng.init(jax.random.PRNGKey(1))
         jax.block_until_ready(s)
@@ -219,6 +220,12 @@ def main() -> None:
         "vs_baseline": round(rate / 100_000.0, 3),
         "platform": platform,
         "quick": quick,
+        # cross-round comparability for the fallback number: sizes are
+        # fixed by `quick`, but the box is not — record core count and
+        # per-rep spread so a contended 1-core machine can't be read as
+        # a regression (VERDICT r3 weak #1)
+        "nproc": os.cpu_count(),
+        "rep_wall_s": [round(t, 4) for t in rep_times],
     }
 
     dev = jax.devices()[0]
