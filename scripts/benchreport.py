@@ -508,8 +508,64 @@ def to_markdown(rows, seeds):
                     f"* {r['problem']} / {r['mode']}: solved "
                     f"{r['seeds'] - r['censored']}/{r['seeds']} seeds "
                     f"within budget")
+    if any(r["problem"].startswith("gcc-real") for r in rows):
+        lines += ["", GCC_REAL_ANALYSIS]
     lines.append("")
     return "\n".join(lines)
+
+
+# Committed analysis (VERDICT r3 next-step #2's accepted alternative):
+# lives here, not as a hand-edit of BENCHREPORT.md, so regeneration
+# preserves it.  Raw three-arm data: benchreport_state_r4.jsonl
+# (baseline + surrogate, matched seeds 1000-1009, traces + thresholds
+# per row) and diag_noprune.jsonl (prune-disabled arm, same seeds).
+GCC_REAL_ANALYSIS = """\
+## Why the surrogate does not beat the bandit on gcc-real (analysis)
+
+Protocol v2 (both modes seeded with the declared-defaults -O2 trial,
+solved = 22% under the -O2 anchor, 80-eval budget, 10 matched seeds)
+measured three arms on the qsort payload:
+
+| arm | median iters | IQR | censored |
+|---|---|---|---|
+| baseline (seeded AUC bandit) | 19.5 | 16-30 | 1/10 |
+| surrogate (EI prune + pool) | 29 | 18-47 | 0/10 |
+| surrogate, prune disabled (pool only) | 28 | 20-71 | 2/10 |
+
+Three observations pin the mechanism:
+
+1. On seeds that solve in ≤20 evals, the surrogate rows are IDENTICAL
+   to baseline — the GP first fits at 16 points, so fast seeds never
+   see it.  The surrogate can only influence the hard tail.
+2. On the hard tail it is actively harmful in both variants: the
+   damage is not the prune (disabling it does not recover baseline),
+   it is the plane itself.  Pool tickets are 8-eval EI-ranked local
+   flips that displace ~30-eval bandit batches, so each pool
+   acquisition narrows per-eval diversity exactly when diversity is
+   what solves the seed; and with ≤80 observations over 328 parameters
+   (1123 surrogate features) the GP posterior is prior-dominated in
+   almost every direction, so its EI ranking of candidate flips is
+   noise wearing a confidence interval.
+3. The bandit's own NormalGreedyMutation applies far bolder moves
+   (σ=0.1 on unit lanes flips a large fraction of the 233 categorical
+   lanes per candidate) — on this payload the landscape rewards bold
+   exploration from the -O2 seed, not model-guided refinement.
+
+What actually won on the real workload is protocol v2's seeding: last
+round's unseeded runs took 63-75 median iters to a SHALLOWER (15%)
+target; the seeded bandit reaches a DEEPER (22%) target in ~20.  That
+matches the reference's own design: OpenTuner's recommended
+configuration for compiler flags is the bandit portfolio, with
+learned models as offline estimators rather than in-loop gatekeepers.
+The surrogate plane's wins are real where structure and budget allow
+(0.13-0.46x on rosenbrock/gcc-options-shaped spaces, thousands of
+evals over ≤200 params); when `n_scalar` exceeds the eval budget the
+stack now warns that surrogate guidance is statistically underpowered
+(driver.py), and baseline mode is the documented recommendation.
+The mmm payload corroborates the budget argument from the other side:
+it solves in ≤7 median evals — before the surrogate activates at all —
+so both modes measure identically (ratio 1.0).
+"""
 
 
 if __name__ == "__main__":

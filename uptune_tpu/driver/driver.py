@@ -779,6 +779,20 @@ class Tuner:
             target: Optional[float] = None) -> TuneResult:
         """Run until `test_limit` evaluations (driver.py:25-26 default
         5000), a wall-clock limit, or a target QoR is reached."""
+        if (self.surrogate is not None
+                and self.space.n_scalar > test_limit):
+            # measured on gcc-real (BENCHREPORT "Why the surrogate does
+            # not beat the bandit"): with fewer evals than parameters
+            # the GP posterior is prior-dominated and in-loop guidance
+            # is neutral-to-harmful — warn rather than silently disable
+            # (the surrogate is opt-in; the user may have reasons)
+            import warnings
+            warnings.warn(
+                f"surrogate guidance is statistically underpowered "
+                f"here: {self.space.n_scalar} scalar parameters vs a "
+                f"{test_limit}-eval budget (measured neutral-to-harmful "
+                f"on the real gcc space, see BENCHREPORT.md); consider "
+                f"running without a learning model", UserWarning)
         t0 = time.time()
         no_eval_streak = 0
         while self.evals < test_limit:
