@@ -278,10 +278,14 @@ def _register_portfolios():
     # both fill the broad-exploration role, CMA-ES adapts its search
     # distribution) under the same AUC bandit — opt-in via --technique,
     # the reference-faithful AUCBanditMetaTechniqueA stays the default.
-    # Measured (rosenbrock-4d, thresh 1.0, budget 4000, 10 seeds, no
-    # surrogate): median 1712 iters / 3 censored vs portfolio A's 2412 /
-    # 47% censored at 30 seeds — 0.71x iterations with the same
-    # evaluation plane
+    # The matched 30-seed A/B (scripts/ab_portfolio.py, AB_PORTFOLIO.md:
+    # rosenbrock-4d, thresh 1.0, budget 4000, identical seed lists)
+    # has it LOSING to portfolio A — median 3916 vs 2412 iters (1.62x),
+    # solve-rate 15/30 vs 16/30.  An earlier 10-seed sample (median
+    # 1712) was a lucky draw; this stays opt-in and is NOT recommended
+    # as a portfolio-A replacement.  CMAES remains valuable as a
+    # standalone arm on smooth continuous spaces (test_cmaes converges
+    # 600-eval rosenbrock-2d).
     from .cmaes import CMAES
     register(_portfolio("AUCBanditMetaTechniqueTPU", [
         de_alt(), ugm(sigma=0.1, mutation_rate=0.3,
