@@ -551,6 +551,21 @@ Three observations pin the mechanism:
    lanes per candidate) — on this payload the landscape rewards bold
    exploration from the -O2 seed, not model-guided refinement.
 
+The stored traces make the conclusion threshold-independent (each
+run's iters re-scored post-hoc against its own anchor, no recompiles):
+
+| target under -O2 | baseline median (cens) | surrogate median (cens) | ratio |
+|---|---|---|---|
+| 15% | 18.0 (1) | 18.0 (0) | 1.00 |
+| 20% | 18.0 (1) | 18.0 (0) | 1.00 |
+| 22% | 19.5 (1) | 29.0 (0) | 1.49 |
+| 25% | 19.5 (2) | 36.5 (1) | 1.87 |
+
+At shallow targets the modes are indistinguishable (both solve inside
+the pre-surrogate window); the deeper the target — i.e. the more the
+hard tail matters — the worse the surrogate plane does.  The penalty
+is monotone in exactly the regime a useful model would have to win.
+
 What actually won on the real workload is protocol v2's seeding: last
 round's unseeded runs took 63-75 median iters to a SHALLOWER (15%)
 target; the seeded bandit reaches a DEEPER (22%) target in ~20.  That
