@@ -227,3 +227,99 @@ class TestMixedKernel:
         out = m.propose_pool(jax.random.PRNGKey(2),
                              cands.u[best_i], (), float(y[best_i]))
         assert out is not None and out.u.shape[0] == 8
+
+
+class TestSurrogateActivityGuards:
+    """Two measured guards (BENCHREPORT gcc-real analysis): the
+    observation gate `min_model_points` (explicit knob, inert by
+    default) and the run-budget `passive` rule the driver applies when
+    the eval budget is smaller than the parameter count."""
+
+    def _mgr(self, space, **kw):
+        return SurrogateManager(space, "gp", min_points=16,
+                                refit_interval=16, propose_batch=8,
+                                pool_mult=16, seed=0, **kw)
+
+    def _cat_space(self, n=40):
+        from uptune_tpu.space.params import EnumParam
+        return Space([EnumParam(f"f{i}", ("a", "b", "c"))
+                      for i in range(n)])
+
+    def test_observation_gate_suppresses_prune_and_pool(self):
+        sp = self._cat_space()
+        m = self._mgr(sp, min_model_points=40)
+        rng = np.random.RandomState(0)
+        cands = sp.random(jax.random.PRNGKey(0), 32)
+        y = rng.rand(32).astype(np.float32)
+        m.observe(np.asarray(sp.features(cands)), y)
+        assert m.maybe_refit()          # it still fits...
+        assert m.fitted
+        assert m.keep_mask(cands) is None           # ...but won't veto
+        assert m.propose_pool(jax.random.PRNGKey(1), cands.u[0], (),
+                              1.0) is None          # ...or propose
+        # past the gate both activate
+        cands2 = sp.random(jax.random.PRNGKey(2), 32)
+        m.observe(np.asarray(sp.features(cands2)),
+                  rng.rand(32).astype(np.float32))
+        m.maybe_refit()
+        assert m.keep_mask(cands2) is not None
+        assert m.propose_pool(jax.random.PRNGKey(3), cands2.u[0], (),
+                              1.0) is not None
+
+    def test_default_gate_is_inert(self):
+        # gating on observations by default COSTS evals where guidance
+        # from min_points already pays (gcc-options probe: 1553 gated
+        # vs 1046.5 ungated median) — default must stay min_points
+        m = self._mgr(self._cat_space(200))
+        assert m.min_model_points == 16
+
+    def test_budget_rule_sets_passive_and_warns(self):
+        import warnings
+
+        sp = self._cat_space(40)
+
+        def obj(cfgs):
+            return [1.0 for _ in cfgs]
+
+        t = Tuner(sp, obj, seed=0, surrogate="gp",
+                  surrogate_opts={"min_points": 16, "propose_batch": 8})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t.run(test_limit=20)    # 20 < 40 scalar params
+        t.close()
+        assert t.surrogate.passive
+        assert any("PASSIVE" in str(x.message) for x in w)
+
+    def test_budget_rule_respects_opt_out_and_big_budgets(self):
+        sp = self._cat_space(40)
+
+        def obj(cfgs):
+            return [1.0 for _ in cfgs]
+
+        t = Tuner(sp, obj, seed=0, surrogate="gp",
+                  surrogate_opts={"min_points": 16,
+                                  "auto_passive": False})
+        t._apply_budget_rule(20)
+        assert not t.surrogate.passive
+        t.close()
+        t2 = Tuner(sp, obj, seed=0, surrogate="gp",
+                   surrogate_opts={"min_points": 16})
+        t2._apply_budget_rule(4000)   # budget >> params: stays active
+        assert not t2.surrogate.passive
+        t2.close()
+
+    def test_budget_rule_is_per_run(self):
+        """A later large-budget run on the same tuner re-activates what
+        the rule itself passivated (r4 review: the flag must not stick);
+        user-set passive flags are left alone."""
+        sp = self._cat_space(40)
+        t = Tuner(sp, lambda cfgs: [1.0] * len(cfgs), seed=0,
+                  surrogate="gp", surrogate_opts={"min_points": 16})
+        t._apply_budget_rule(20)
+        assert t.surrogate.passive
+        t._apply_budget_rule(4000)
+        assert not t.surrogate.passive      # rule-set flag cleared
+        t.surrogate.passive = True          # user-set
+        t._apply_budget_rule(4000)
+        assert t.surrogate.passive          # left alone
+        t.close()

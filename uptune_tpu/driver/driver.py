@@ -779,20 +779,7 @@ class Tuner:
             target: Optional[float] = None) -> TuneResult:
         """Run until `test_limit` evaluations (driver.py:25-26 default
         5000), a wall-clock limit, or a target QoR is reached."""
-        if (self.surrogate is not None
-                and self.space.n_scalar > test_limit):
-            # measured on gcc-real (BENCHREPORT "Why the surrogate does
-            # not beat the bandit"): with fewer evals than parameters
-            # the GP posterior is prior-dominated and in-loop guidance
-            # is neutral-to-harmful — warn rather than silently disable
-            # (the surrogate is opt-in; the user may have reasons)
-            import warnings
-            warnings.warn(
-                f"surrogate guidance is statistically underpowered "
-                f"here: {self.space.n_scalar} scalar parameters vs a "
-                f"{test_limit}-eval budget (measured neutral-to-harmful "
-                f"on the real gcc space, see BENCHREPORT.md); consider "
-                f"running without a learning model", UserWarning)
+        self._apply_budget_rule(test_limit)
         t0 = time.time()
         no_eval_streak = 0
         while self.evals < test_limit:
@@ -807,6 +794,41 @@ class Tuner:
             if target is not None and self._target_met(target):
                 break
         return self.result()
+
+    def _apply_budget_rule(self, test_limit: int) -> None:
+        """Run-budget surrogate rule (measured, BENCHREPORT "Why the
+        surrogate does not beat the bandit on gcc-real"): with fewer
+        evals than scalar parameters the GP posterior stays
+        prior-dominated for the whole run and in-loop guidance measured
+        neutral-to-harmful (1.49x on gcc-real) — while the SAME guidance
+        wins 0.14-0.46x when the budget dwarfs the dimension.  So when
+        `test_limit < n_scalar`, flip the manager passive (observe +
+        fit only) unless the user opted out via auto_passive=False.
+        Called from run(); external ask/tell pacers know their own
+        budgets and can set surrogate.passive directly (the CLI
+        controller applies the same rule)."""
+        sm = self.surrogate
+        if sm is None or not getattr(sm, "auto_passive", False):
+            return
+        if test_limit < self.space.n_scalar:
+            if getattr(sm, "passive", False):
+                return      # already passive (this rule or the user)
+            sm.passive = True
+            sm._auto_passivated = True
+            import warnings
+            warnings.warn(
+                f"surrogate set PASSIVE for this run: budget "
+                f"{test_limit} evals < {self.space.n_scalar} scalar "
+                f"parameters, a regime where in-loop guidance is "
+                f"measured neutral-to-harmful (BENCHREPORT.md); pass "
+                f"surrogate_opts={{'auto_passive': False}} to override",
+                UserWarning)
+        elif getattr(sm, "_auto_passivated", False):
+            # the rule is per RUN: a later large-budget run on the same
+            # tuner re-activates what the rule itself passivated
+            # (user-set passive flags are left alone)
+            sm.passive = False
+            sm._auto_passivated = False
 
     def _target_met(self, target: float) -> bool:
         q = float(self.best.qor)

@@ -269,6 +269,9 @@ class ProgramTuner:
         records = self.params[self.stage]
         space = space_from_params(records)
         self.tuner = tuner = self._make_tuner(space)
+        # the CLI drives ask/tell (not Tuner.run), so the run-budget
+        # surrogate rule is applied here where the limit is known
+        tuner._apply_budget_rule(limit)
 
         queue: collections.deque = collections.deque()
         # seed trial: the program's declared defaults; its QoR was already
