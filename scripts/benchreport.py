@@ -522,6 +522,9 @@ def to_markdown(rows, seeds):
 GCC_REAL_ANALYSIS = """\
 ## Why the surrogate does not beat the bandit on gcc-real (analysis)
 
+![gcc-real convergence, 10 matched seeds](docs/img/gccreal_r4.png)
+(regenerate: `python scripts/plot_gccreal.py`)
+
 Protocol v2 (both modes seeded with the declared-defaults -O2 trial,
 solved = 22% under the -O2 anchor, 80-eval budget, 10 matched seeds)
 measured four arms on the qsort payload:
