@@ -323,3 +323,33 @@ class TestSurrogateActivityGuards:
         t._apply_budget_rule(4000)
         assert t.surrogate.passive          # left alone
         t.close()
+
+
+def test_mixed_kernel_with_permutation_block():
+    """Perm position lanes live in the CONTINUOUS block of the
+    surrogate representation; a space with perms + enums + ints must
+    fit, score, and pool-propose without shape drift."""
+    from uptune_tpu.space.params import EnumParam, IntParam, PermParam
+    sp = Space([PermParam("tour", items=tuple(range(6)))]
+               + [EnumParam(f"f{i}", ("a", "b", "c")) for i in range(5)]
+               + [IntParam("p", 0, 9)])
+    assert sp.n_cat == 5
+    assert sp.n_cont_features == 1 + 6      # int lane + 6 perm positions
+    cands = sp.random(jax.random.PRNGKey(0), 48)
+    feats = sp.surrogate_transform(sp.features(cands))
+    assert feats.shape == (48, sp.n_surrogate_features)
+    y = jnp.asarray(np.random.RandomState(0).rand(48), jnp.float32)
+    st = gp.fit_auto(feats, y, n_cont=sp.n_cont_features, n_cat=sp.n_cat)
+    mu, sd = gp.predict(st, feats[:8], sp.n_cont_features, sp.n_cat)
+    assert np.isfinite(np.asarray(mu)).all()
+    assert (np.asarray(sd) >= 0).all()
+    m = SurrogateManager(sp, "gp", min_points=16, refit_interval=16,
+                         propose_batch=4, pool_mult=8, seed=0)
+    m.observe(np.asarray(sp.features(cands)), np.asarray(y))
+    assert m.maybe_refit()
+    out = m.propose_pool(jax.random.PRNGKey(1), cands.u[0],
+                         tuple(p[0] for p in cands.perms), 0.5)
+    assert out is not None and out.u.shape[0] == 4
+    # proposed permutations are valid orderings
+    for row in np.asarray(out.perms[0]):
+        assert sorted(row.tolist()) == list(range(6))
