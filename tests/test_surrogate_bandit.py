@@ -1,0 +1,200 @@
+"""Surrogate arbitration='bandit': the proposal plane as a credit-earning
+VIRTUAL ARM of the AUC bandit (techniques/bandit.py register_virtual_arm +
+driver/driver.py _surrogate_ticket(credit=True)).
+
+Where the scheduled plane fires every propose_every-th acquisition
+unconditionally (and the run-budget rule can only switch it off
+wholesale), bandit arbitration routes the decision through the same AUC
+credit math that arbitrates technique arms (reference credit semantics:
+/root/reference/python/uptune/opentuner/search/bandittechniques.py:116-146)
+— pulls that stop producing new bests decay the arm's score and the
+bandit starves it, per run, with no static threshold."""
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from uptune_tpu.driver import Tuner
+from uptune_tpu.space.params import FloatParam
+from uptune_tpu.space.spec import Space
+from uptune_tpu.techniques.bandit import AUCBanditMeta, AUCBanditQueue
+from uptune_tpu.workloads import rosenbrock_objective, rosenbrock_space
+
+
+def _opts(**kw):
+    o = dict(min_points=16, refit_interval=16, select="topk",
+             keep_frac=0.5, explore_frac=0.1, score="ei",
+             propose_batch=8, pool_mult=16, arbitration="bandit")
+    o.update(kw)
+    return o
+
+
+class TestQueueVirtualArms:
+    def test_add_key_starts_unpulled(self):
+        q = AUCBanditQueue(["a", "b"], seed=0)
+        for k in ("a", "b"):
+            for v in (True, False):
+                q.on_result(k, v)
+        q.add_key("v")
+        assert q.use_counts["v"] == 0
+        assert q.bandit_score("v") == float("inf")
+        assert q.ordered_keys()[0] == "v"
+
+    def test_add_key_idempotent(self):
+        q = AUCBanditQueue(["a"], seed=0)
+        q.on_result("a", True)
+        q.add_key("a")
+        assert q.keys.count("a") == 1
+        assert q.use_counts["a"] == 1
+
+    def test_loser_arm_demoted(self):
+        """An arm whose pulls never produce new bests must rank below an
+        arm with wins once both have been tried."""
+        q = AUCBanditQueue(["good", "bad"], seed=0)
+        for _ in range(10):
+            q.on_result("good", True)
+            q.on_result("bad", False)
+        assert q.bandit_score("good") > q.bandit_score("bad")
+        assert q.ordered_keys()[0] == "good"
+
+    def test_meta_register_virtual_arm(self):
+        from uptune_tpu.techniques.base import get_root
+        root = get_root(["AUCBanditMetaTechniqueA"])
+        assert isinstance(root, AUCBanditMeta)
+        root.register_virtual_arm("surrogate")
+        assert "surrogate" in root.bandit.use_counts
+        assert "surrogate" in root.ordered_names()
+        # Technique-only callers never see the virtual arm
+        assert all(t.name != "surrogate" for t in root.select_order())
+
+    def test_virtual_arm_name_collision_raises(self):
+        from uptune_tpu.techniques.base import get_root
+        root = get_root(["AUCBanditMetaTechniqueA"])
+        with pytest.raises(ValueError):
+            root.register_virtual_arm("DifferentialEvolutionAlt")
+
+
+class TestDriverWiring:
+    def test_registers_virtual_arm(self):
+        space = rosenbrock_space(2, -2.0, 2.0)
+        t = Tuner(space, rosenbrock_objective(2), seed=0, surrogate="gp",
+                  surrogate_opts=_opts())
+        assert t._surr_arm
+        assert "surrogate" in t.root.bandit.use_counts
+
+    def test_non_bandit_root_falls_back_with_warning(self):
+        space = rosenbrock_space(2, -2.0, 2.0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t = Tuner(space, rosenbrock_objective(2), seed=0,
+                      technique="PureRandom", surrogate="gp",
+                      surrogate_opts=_opts())
+        assert not t._surr_arm
+        assert any("bandit" in str(x.message) for x in w)
+
+    def test_propose_batch_zero_falls_back(self):
+        space = rosenbrock_space(2, -2.0, 2.0)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t = Tuner(space, rosenbrock_objective(2), seed=0,
+                      surrogate="gp",
+                      surrogate_opts=_opts(propose_batch=0))
+        assert not t._surr_arm
+        assert any("bandit" in str(x.message) for x in w)
+
+    def test_budget_rule_superseded(self):
+        """auto_passive's budget threshold must NOT passivate the
+        manager under bandit arbitration — the bandit arbitrates."""
+        space = Space([FloatParam(f"x{i}", 0, 1) for i in range(32)])
+        t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                  surrogate="gp",
+                  surrogate_opts=_opts(auto_passive=True))
+        t._apply_budget_rule(test_limit=5)  # 5 << 32 scalar params
+        assert not t.surrogate.passive
+        # and the scheduled-mode rule still fires when arbitration is off
+        t2 = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                   surrogate="gp",
+                   surrogate_opts=_opts(arbitration="schedule",
+                                        auto_passive=True))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t2._apply_budget_rule(test_limit=5)
+        assert t2.surrogate.passive
+
+
+@pytest.mark.slow
+class TestBanditArbitrationRuns:
+    def test_pulls_match_credit_events(self):
+        """Every surrogate ticket the bandit pulls must feed exactly one
+        AUC event: arm_stats pulls == queue use_counts (no phantom
+        pulls, no uncredited pulls)."""
+        space = rosenbrock_space(2, -2.048, 2.048)
+        t = Tuner(space, rosenbrock_objective(2), seed=7, surrogate="gp",
+                  surrogate_opts=_opts())
+        t.run(test_limit=300)
+        pulls = t.arm_stats.get("surrogate", [0, 0, 0])[0]
+        assert pulls > 0, t.arm_stats
+        assert t.root.bandit.use_counts["surrogate"] == pulls
+
+    def test_useless_plane_is_starved(self):
+        """A proposal plane that only ever re-proposes the incumbent
+        (saturated pool) must cost nothing: no ticket is ever opened
+        (the walk falls through to technique arms, keeping the
+        random-injection saturation escape reachable — r4 review), no
+        credit events accrue, and the dry backoff bounds how often the
+        pool is even scored."""
+        space = rosenbrock_space(2, -2.048, 2.048)
+
+        class SaturatedManager:
+            arbitration = "bandit"
+            propose_batch = 8
+            propose_every = 1
+            fitted = True
+            passive = False
+            auto_passive = False
+
+            def observe(self, feats, qor):
+                pass
+
+            def maybe_refit(self):
+                return False
+
+            def keep_mask(self, cands, candidate_mask=None):
+                return None
+
+            def propose_pool(self, key, best_u, best_perms, best_y):
+                # 8 copies of the incumbent: always fully duplicate
+                import jax.numpy as jnp
+                from uptune_tpu.space.spec import CandBatch
+                u = jnp.tile(jnp.asarray(best_u)[None, :], (8, 1))
+                return CandBatch(u, ())
+
+            def prune(self, *a, **kw):
+                return None
+
+        t = Tuner(space, rosenbrock_objective(2), seed=9,
+                  surrogate=SaturatedManager())
+        assert t._surr_arm
+        res = t.run(test_limit=200)
+        # the run itself made progress through technique arms
+        assert res.evals >= 100, res.evals
+        # a saturated pool never opens a ticket: zero pulls, zero
+        # credit events, zero evals attributed to the plane
+        assert t.root.bandit.use_counts["surrogate"] == 0
+        assert "surrogate" not in t.arm_stats
+        assert t.root.bandit.exploitation_term("surrogate") == 0.0
+
+    def test_helpful_plane_outscores_techniques(self):
+        """On smooth rosenbrock the fitted GP plane produces new bests
+        at a far higher rate than mutation arms — the bandit must
+        learn to rank it first (the r4 design's whole point)."""
+        space = rosenbrock_space(4, -2.048, 2.048)
+        t = Tuner(space, rosenbrock_objective(4), seed=5, surrogate="gp",
+                  surrogate_opts=_opts())
+        t.run(test_limit=500)
+        bq = t.root.bandit
+        assert bq.use_counts["surrogate"] > 0
+        others = [bq.bandit_score(k) for k in bq.keys if k != "surrogate"]
+        assert bq.bandit_score("surrogate") > max(others), {
+            k: bq.bandit_score(k) for k in bq.keys}

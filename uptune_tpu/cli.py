@@ -50,6 +50,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable the surrogate plane (EI top-k pruning "
                         "+ pool proposals, calibrated defaults); the "
                         "reference's --learning-models flag")
+    p.add_argument("--surrogate-arbitration", default=None,
+                   choices=("schedule", "bandit"),
+                   help="how the surrogate proposal plane gets "
+                        "acquisitions: 'schedule' fires every Nth "
+                        "acquisition (with the run-budget passivation "
+                        "rule), 'bandit' registers it as a "
+                        "credit-earning arm of the AUC bandit, which "
+                        "starves it per-run when its pulls stop "
+                        "producing new bests")
     p.add_argument("--seed", type=int, default=None, help="RNG seed")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
@@ -295,13 +304,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "%r and ignoring %r (the mlp kind is itself an "
                     "ensemble)", surrogate, models[1:])
 
+    sopts = ({"arbitration": args.surrogate_arbitration}
+             if args.surrogate_arbitration else None)
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,
         parallel=args.parallel_factor, test_limit=args.test_limit,
         runtime_limit=args.runtime_limit, timeout=args.timeout,
         technique=technique, seed=args.seed, params_file=args.params,
         resume=args.resume, sandbox=not args.no_sandbox,
-        surrogate=surrogate, template=template)
+        surrogate=surrogate, surrogate_opts=sopts, template=template)
 
     if args.cfg:
         for k in sorted(settings):

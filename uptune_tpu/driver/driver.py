@@ -82,10 +82,10 @@ class _Ticket:
 
     __slots__ = ("arm", "arm_name", "tstate", "cands", "hashes", "known",
                  "src", "novel_np", "injected", "pruned", "trials",
-                 "remaining", "u_np", "perms_np", "gen")
+                 "remaining", "u_np", "perms_np", "gen", "credit_virtual")
 
     def __init__(self, arm, arm_name, tstate, cands, hashes, known, src,
-                 novel_np, injected, pruned, gen=0):
+                 novel_np, injected, pruned, gen=0, credit_virtual=False):
         self.arm = arm
         self.arm_name = arm_name
         self.tstate = tstate
@@ -96,6 +96,10 @@ class _Ticket:
         self.novel_np = novel_np
         self.injected = injected
         self.pruned = pruned
+        # injected ticket that still earns bandit credit: the surrogate
+        # virtual arm (arbitration='bandit') — no technique state to
+        # observe, but its pull outcome feeds the AUC queue
+        self.credit_virtual = credit_virtual
         self.trials: List[Trial] = []
         self.remaining = 0
         self.u_np = None
@@ -253,6 +257,27 @@ class Tuner:
                 lambda st, k, best, _t=t: _t.propose(space, st, k, best))
             self._observe_jit[t.name] = jax.jit(
                 lambda st, c, q, best, _t=t: _t.observe(space, st, c, q, best))
+
+        # surrogate arbitration='bandit': the proposal plane becomes a
+        # credit-earning VIRTUAL ARM of the AUC bandit instead of firing
+        # on a fixed schedule — the bandit's AUC credit decides when the
+        # pool displaces a technique batch, and starves it when its
+        # pulls stop paying (the measured gcc-real failure mode of the
+        # scheduled plane, BENCHREPORT.md).
+        self._surr_arm = False
+        sm = self.surrogate
+        if sm is not None and getattr(sm, "arbitration", "") == "bandit":
+            from ..techniques.bandit import AUCBanditMeta
+            if isinstance(self.root, AUCBanditMeta) and getattr(
+                    sm, "propose_batch", 0):
+                self.root.register_virtual_arm("surrogate")
+                self._surr_arm = True
+            else:
+                import warnings
+                warnings.warn(
+                    "surrogate arbitration='bandit' needs an AUC-bandit "
+                    "root technique and propose_batch > 0; falling back "
+                    "to the scheduled proposal plane", UserWarning)
 
         sp, hist = self.space, self.history
 
@@ -428,20 +453,25 @@ class Tuner:
             novel_np = novel_np & ~np.isin(packed, pend)
         return novel_np, int(novel_np.sum())
 
-    def _acquire_surrogate(self) -> Optional[_Ticket]:
-        """Surrogate proposal plane: every `propose_every`-th acquisition
-        (once fitted) the manager emits its own EI-maximizing batch from
-        an oversampled pool (surrogate/manager.py propose_pool) instead of
-        only filtering an arm's batch.  The ticket carries no technique
-        state and earns no bandit credit (like injected seeds), but IS
-        attributed in the archive as 'surrogate'."""
+    def _surrogate_ticket(self, credit: bool) -> Optional[_Ticket]:
+        """Try to pull the surrogate proposal plane once: EI-maximizing
+        batch from an oversampled pool (surrogate/manager.py
+        propose_pool), deduped and opened as an injected ticket
+        attributed 'surrogate'.
+
+        Either way a saturated pool opens NO ticket — no pull counted,
+        no phantom zero-eval step (ADVICE r2) — it just marks the arm
+        dry (backoff skips the next few acquisitions) and the walk
+        falls through to a technique arm.  Under credit=True that
+        fall-through is load-bearing: a dup-serving virtual ticket
+        would return from _acquire without running the technique path,
+        freezing _zero_novel_streak and its random-injection
+        saturation escape (r4 review).  Negative bandit feedback still
+        flows from pulls that evaluate and fail to improve."""
         sm = self.surrogate
         if (sm is None or not getattr(sm, "propose_batch", 0)
                 or not sm.fitted
                 or not math.isfinite(float(self.best.qor))):
-            return None
-        self._surr_tick += 1
-        if self._surr_tick % max(1, sm.propose_every):
             return None
         self.key, k = jax.random.split(self.key)
         cands = sm.propose_pool(k, self.best.u, self.best.perms,
@@ -450,19 +480,35 @@ class Tuner:
             return None
         pre = self._dedup_masked(cands)
         if not pre[3].any():
-            # pool saturated around the incumbent: nothing novel, so no
-            # ticket is opened at all — no pull counted, no phantom
-            # zero-eval step — and the arms take this acquisition
-            # (ADVICE r2: the old path opened then abandoned the ticket,
-            # inflating arm_stats['surrogate'] pulls)
+            self._arm_dry["surrogate"] = self._acq_count
             return None
-        tk = self._open_injected_ticket(cands, "surrogate", _pre=pre)
-        if not tk.trials:
+        self._arm_dry.pop("surrogate", None)
+        tk = self._open_injected_ticket(cands, "surrogate", _pre=pre,
+                                        credit_virtual=credit)
+        if not tk.trials and not credit:
             # every novel row was rejected by the user's config filter:
             # the pull genuinely happened and produced 0 trials (counted
             # as such); nothing is pending, so no finalize is needed
             return None
         return tk
+
+    def _acquire_surrogate(self) -> Optional[_Ticket]:
+        """Scheduled surrogate proposal plane: every `propose_every`-th
+        acquisition (once fitted) the manager emits its own batch
+        instead of only filtering an arm's batch.  The ticket carries no
+        technique state and earns no bandit credit (like injected
+        seeds), but IS attributed in the archive as 'surrogate'.  Under
+        arbitration='bandit' this path is off — the AUC bandit pulls
+        the plane as a virtual arm in _acquire instead."""
+        sm = self.surrogate
+        if (sm is None or not getattr(sm, "propose_batch", 0)
+                or not sm.fitted
+                or not math.isfinite(float(self.best.qor))):
+            return None
+        self._surr_tick += 1
+        if self._surr_tick % max(1, sm.propose_every):
+            return None
+        return self._surrogate_ticket(credit=False)
 
     def _dedup_masked(self, cands: CandBatch):
         """(hashes, known, src, novel_np): dedup vs history + in-batch,
@@ -474,14 +520,17 @@ class Tuner:
                 np.asarray(src), novel_np)
 
     def _open_injected_ticket(self, cands: CandBatch, source: str,
-                              _pre=None) -> _Ticket:
+                              _pre=None, credit_virtual=False) -> _Ticket:
         """Dedup -> pending-mask -> injected ticket -> open: the shared
         plumbing behind inject() and the surrogate proposal plane.
-        Injected tickets never touch technique states or bandit credit."""
+        Injected tickets never touch technique states; they skip bandit
+        credit too unless credit_virtual (the bandit-arbitrated
+        surrogate arm)."""
         hashes, known, src, novel_np = (_pre if _pre is not None
                                         else self._dedup_masked(cands))
         tk = _Ticket(None, source, None, cands, hashes, known, src,
-                     novel_np, injected=True, pruned=0)
+                     novel_np, injected=True, pruned=0,
+                     credit_virtual=credit_virtual)
         self._open_ticket(tk)
         return tk
 
@@ -489,12 +538,24 @@ class Tuner:
         """Choose arm -> propose batch -> dedup (history + in-batch +
         pending) -> surrogate prune; returns the open ticket."""
         self._acq_count += 1
-        tk = self._acquire_surrogate()
-        if tk is not None:
-            return tk
-        order = (self.root.select_order()
-                 if isinstance(self.root, MetaTechnique) else [self.root])
-        order = [t for t in order if t.name in self._tstates]
+        if not self._surr_arm:
+            tk = self._acquire_surrogate()
+            if tk is not None:
+                return tk
+            order = (self.root.select_order()
+                     if isinstance(self.root, MetaTechnique)
+                     else [self.root])
+            order = [t for t in order if t.name in self._tstates]
+        else:
+            # bandit arbitration: the AUC queue orders techniques AND
+            # the 'surrogate' virtual arm together; the sentinel string
+            # marks the virtual pull in the walk below
+            order = []
+            for n in self.root.ordered_names():
+                if n in self.root.virtual_arms:
+                    order.append(n)
+                elif n in self._tstates:
+                    order.append(self._member_by_name[n])
         if self._arm_dry:
             dry = {n for n, s in self._arm_dry.items()
                    if self._acq_count - s < self._dry_backoff}
@@ -502,11 +563,22 @@ class Tuner:
                 # arms inside the backoff window are skipped outright;
                 # when every arm is dry, one proposes (to serve dups /
                 # advance the saturation streak) instead of all of them
-                active = [t for t in order if t.name not in dry]
+                active = [t for t in order
+                          if (t if isinstance(t, str) else t.name)
+                          not in dry]
                 order = active if active else order[:1]
+        if not any(not isinstance(t, str) for t in order):
+            # every surviving entry is virtual: a failed virtual pull
+            # must still leave a technique to fall back on
+            order.append(self.members[0])
 
         chosen = None
         for t in order:
+            if isinstance(t, str):  # virtual arm: the surrogate plane
+                stk = self._surrogate_ticket(credit=True)
+                if stk is not None:
+                    return stk
+                continue  # can't pull (not fitted / saturated): next arm
             self.key, k = jax.random.split(self.key)
             tstate, cands = self._propose_jit[t.name](
                 self._tstates[t.name], k, self.best)
@@ -730,6 +802,15 @@ class Tuner:
                         self.key, k = jax.random.split(self.key)
                         self._tstates[nm] = t.init_state(self.space, k)
                         self._tgen[nm] = self._tgen.get(nm, 0) + 1
+        elif tk.credit_virtual and isinstance(self.root, MetaTechnique):
+            # bandit-arbitrated surrogate pull: no technique state to
+            # observe, but the outcome is the virtual arm's AUC event
+            step_best = min((tr.qor for tr in live), default=float("inf"))
+            if self._credit_kw:
+                self.root.credit(tk.arm_name, was_new_best,
+                                 step_best=step_best, global_best=new)
+            else:
+                self.root.credit(tk.arm_name, was_new_best)
         if was_new_best:
             self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])[2] += 1
         dropped = int(self.hist_state.dropped)
@@ -809,6 +890,11 @@ class Tuner:
         controller applies the same rule)."""
         sm = self.surrogate
         if sm is None or not getattr(sm, "auto_passive", False):
+            return
+        if self._surr_arm:
+            # arbitration='bandit' supersedes the static rule: the AUC
+            # queue learns per-run whether surrogate pulls pay, instead
+            # of an all-or-nothing budget threshold
             return
         if test_limit < self.space.n_scalar:
             if getattr(sm, "passive", False):

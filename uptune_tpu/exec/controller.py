@@ -128,6 +128,12 @@ class ProgramTuner:
                                    **(surrogate_opts or {})}
         else:
             self.surrogate_opts = surrogate_opts
+            if surrogate is None and surrogate_opts:
+                log.warning(
+                    "[ut] surrogate options %s have no effect: no "
+                    "learning model is enabled (pass --learning-models "
+                    "/ ut.config learning-model)",
+                    sorted(surrogate_opts))
         self.env_extra = dict(env or {})
         # children (analysis run + sandboxed eval workers) must be able
         # to `import uptune_tpu` even from a plain checkout with no

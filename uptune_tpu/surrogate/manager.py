@@ -42,9 +42,13 @@ class SurrogateManager:
                  propose_batch: int = 0, propose_every: int = 2,
                  pool_mult: int = 32,
                  min_model_points: Optional[int] = None,
-                 auto_passive: bool = True):
+                 auto_passive: bool = True,
+                 arbitration: str = "schedule"):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
+        if arbitration not in ("schedule", "bandit"):
+            raise ValueError(f"unknown arbitration {arbitration!r}; "
+                             f"known: schedule, bandit")
         if select not in ("threshold", "topk"):
             raise ValueError(f"unknown select mode {select!r}")
         if score not in ("lcb", "ei"):
@@ -67,6 +71,15 @@ class SurrogateManager:
         # instead of only filtering technique batches
         self.propose_batch = propose_batch
         self.propose_every = propose_every
+        # arbitration='schedule': the plane fires every propose_every-th
+        # acquisition unconditionally (plus the run-budget passivation
+        # rule).  arbitration='bandit': the plane is a credit-earning
+        # VIRTUAL ARM in the driver's AUC bandit — pulled when its AUC
+        # score wins, starved when its pulls stop producing new bests.
+        # Self-correcting where the static rule is all-or-nothing: the
+        # measured gcc-real harm (BENCHREPORT) came from unconditional
+        # pool tickets displacing bandit batches.
+        self.arbitration = arbitration
         self.pool_mult = pool_mult
         self._pool_jit = None
         self.space = space

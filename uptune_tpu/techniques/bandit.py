@@ -48,6 +48,17 @@ class AUCBanditQueue:
         self.auc_decay: Dict[str, float] = {k: 0.0 for k in keys}
         self.rng = _pyrandom.Random(seed)
 
+    def add_key(self, key: str) -> None:
+        """Register a new arm mid-flight (used for virtual arms like the
+        surrogate proposal plane).  Starts with zero pulls, so the
+        exploration term is +inf and the bandit tries it promptly."""
+        if key in self.use_counts:
+            return
+        self.keys.append(key)
+        self.use_counts[key] = 0
+        self.auc_sum[key] = 0.0
+        self.auc_decay[key] = 0.0
+
     def exploitation_term(self, key: str) -> float:
         pos = self.use_counts[key]
         if not pos:
@@ -133,9 +144,26 @@ class AUCBanditMeta(MetaTechnique):
         self.bandit = AUCBanditQueue([t.name for t in self.techniques],
                                      C=C, window=window, seed=seed)
         self._by_name = {t.name: t for t in self.techniques}
+        # virtual arms compete in the AUC queue but have no Technique:
+        # the driver interprets them itself (e.g. 'surrogate' pulls the
+        # EI proposal pool).  select_order() filters them out so callers
+        # that only understand Techniques keep working.
+        self.virtual_arms: set = set()
+
+    def register_virtual_arm(self, name: str) -> None:
+        if name in self._by_name:
+            raise ValueError(f"arm name {name!r} already taken by a "
+                             f"member technique")
+        self.virtual_arms.add(name)
+        self.bandit.add_key(name)
+
+    def ordered_names(self) -> List[str]:
+        """Full credit-ordered arm-name list, virtual arms included."""
+        return self.bandit.ordered_keys()
 
     def select_order(self) -> List[Technique]:
-        return [self._by_name[k] for k in self.bandit.ordered_keys()]
+        return [self._by_name[k] for k in self.bandit.ordered_keys()
+                if k in self._by_name]
 
     def credit(self, name: str, was_new_best: bool,
                step_best: Optional[float] = None,
