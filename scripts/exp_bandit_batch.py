@@ -1,0 +1,75 @@
+"""Experiment: does pull-size parity fix the bandit-arbitrated plane's
+endgame starvation?
+
+The r4 10-seed sweep showed arbitration='bandit' censoring rosenbrock-4d
+seeds that the scheduled plane solves (0/30 censored, median 346).
+Mechanism hypothesis: the plane's 8-eval pool tickets inflate its AUC
+use_count 4x faster per evaluation than the techniques' ~32-eval
+batches; once new bests get rare near the optimum, the exploration term
+sqrt(2*log2(|history|)/use_count) dominates every score and the
+most-pulled arm — the plane — ranks last exactly where its local
+refinement is the move that finishes the run.
+
+Arms: propose_batch in {8 (sweep config), 16, 32} under bandit
+arbitration, 10 seeds, rosenbrock-4d protocol (thresh 1.0, budget
+4000).  Usage: python scripts/exp_bandit_batch.py [--seeds N]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import cpuenv  # noqa: F401,E402  platform guard before jax
+
+import numpy as np  # noqa: E402
+
+from benchreport import one_run  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=10)
+    ap.add_argument("--batches", type=int, nargs="*", default=[16, 32])
+    ap.add_argument("--state", default="exp_bandit_batch.jsonl")
+    args = ap.parse_args()
+
+    done = {}
+    if os.path.exists(args.state):
+        with open(args.state) as f:
+            for line in f:
+                r = json.loads(line)
+                done[(r["batch"], r["seed"])] = r
+    out = open(args.state, "a")
+    for batch in args.batches:
+        rows = []
+        for s in range(args.seeds):
+            key = (batch, 1000 + s)
+            if key in done:
+                rows.append(done[key])
+                continue
+            r = one_run("rosenbrock-4d", "surrogate-bandit",
+                        seed=1000 + s, budget=4000,
+                        sopts_override={"propose_batch": batch})
+            r.update({"batch": batch, "seed": 1000 + s})
+            rows.append(r)
+            out.write(json.dumps(r) + "\n")
+            out.flush()
+            import jax
+            jax.clear_caches()
+            print(f"  batch={batch} seed={s} iters={r['iters']}"
+                  f"{' (censored)' if r['censored'] else ''}",
+                  file=sys.stderr)
+        iters = np.asarray([r["iters"] for r in rows])
+        print(json.dumps({
+            "batch": batch, "seeds": args.seeds,
+            "median_iters": float(np.median(iters)),
+            "iqr": [float(np.percentile(iters, 25)),
+                    float(np.percentile(iters, 75))],
+            "censored": int(sum(r["censored"] for r in rows))}))
+
+
+if __name__ == "__main__":
+    main()

@@ -103,24 +103,38 @@ class TestDriverWiring:
         assert not t._surr_arm
         assert any("bandit" in str(x.message) for x in w)
 
-    def test_budget_rule_superseded(self):
-        """auto_passive's budget threshold must NOT passivate the
-        manager under bandit arbitration — the bandit arbitrates."""
+    def test_budget_rule_orthogonal_to_arbitration(self):
+        """The run-budget passivation rule gates whether the plane is
+        ACTIVE in BOTH arbitration modes (a technique-batch-sized pool
+        pull is unaffordable on a tiny budget no matter who chooses
+        it); arbitration only decides when an active plane pulls."""
         space = Space([FloatParam(f"x{i}", 0, 1) for i in range(32)])
-        t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
-                  surrogate="gp",
-                  surrogate_opts=_opts(auto_passive=True))
-        t._apply_budget_rule(test_limit=5)  # 5 << 32 scalar params
-        assert not t.surrogate.passive
-        # and the scheduled-mode rule still fires when arbitration is off
-        t2 = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
-                   surrogate="gp",
-                   surrogate_opts=_opts(arbitration="schedule",
-                                        auto_passive=True))
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            t2._apply_budget_rule(test_limit=5)
-        assert t2.surrogate.passive
+        for arb in ("bandit", "schedule"):
+            t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                      surrogate="gp",
+                      surrogate_opts=_opts(arbitration=arb,
+                                           auto_passive=True))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                t._apply_budget_rule(test_limit=5)  # 5 << 32 params
+            assert t.surrogate.passive, arb
+
+    def test_pull_size_parity(self):
+        """Under bandit arbitration the pool batch is raised to the
+        median technique-arm batch (pull-size parity); opting out or
+        using the schedule leaves the configured batch alone."""
+        space = rosenbrock_space(2, -2.0, 2.0)
+        obj = rosenbrock_objective(2)
+        t = Tuner(space, obj, seed=0, surrogate="gp",
+                  surrogate_opts=_opts())
+        bs = sorted(m.natural_batch(space) for m in t.members)
+        assert t.surrogate.propose_batch == max(8, bs[len(bs) // 2])
+        t2 = Tuner(space, obj, seed=0, surrogate="gp",
+                   surrogate_opts=_opts(propose_batch_parity=False))
+        assert t2.surrogate.propose_batch == 8
+        t3 = Tuner(space, obj, seed=0, surrogate="gp",
+                   surrogate_opts=_opts(arbitration="schedule"))
+        assert t3.surrogate.propose_batch == 8
 
 
 @pytest.mark.slow

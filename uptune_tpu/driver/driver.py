@@ -272,6 +272,19 @@ class Tuner:
                     sm, "propose_batch", 0):
                 self.root.register_virtual_arm("surrogate")
                 self._surr_arm = True
+                if getattr(sm, "propose_batch_parity", False):
+                    # pull-size parity: raise the pool batch to the
+                    # median technique-arm batch so one virtual pull
+                    # spends about as many evaluations as one arm pull.
+                    # Without it the plane's small pulls inflate its
+                    # AUC use_count ~4x faster per eval and the
+                    # exploration term starves it in the endgame
+                    # (measured, exp_bandit_batch.jsonl / BENCHREPORT)
+                    bs = sorted(t.natural_batch(space)
+                                for t in self.members)
+                    med = int(bs[len(bs) // 2])
+                    if med > sm.propose_batch:
+                        sm.propose_batch = med
             else:
                 import warnings
                 warnings.warn(
@@ -891,11 +904,11 @@ class Tuner:
         sm = self.surrogate
         if sm is None or not getattr(sm, "auto_passive", False):
             return
-        if self._surr_arm:
-            # arbitration='bandit' supersedes the static rule: the AUC
-            # queue learns per-run whether surrogate pulls pay, instead
-            # of an all-or-nothing budget threshold
-            return
+        # NOTE the rule applies under arbitration='bandit' too:
+        # passivation gates whether the plane is ACTIVE (a 32-eval pool
+        # pull is unaffordable on an 80-eval budget no matter who
+        # chooses it); arbitration only decides WHEN an active plane
+        # pulls.  auto_passive=False opts out as usual.
         if test_limit < self.space.n_scalar:
             if getattr(sm, "passive", False):
                 return      # already passive (this rule or the user)

@@ -43,7 +43,8 @@ class SurrogateManager:
                  pool_mult: int = 32,
                  min_model_points: Optional[int] = None,
                  auto_passive: bool = True,
-                 arbitration: str = "schedule"):
+                 arbitration: str = "schedule",
+                 propose_batch_parity: bool = True):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         if arbitration not in ("schedule", "bandit"):
@@ -76,10 +77,23 @@ class SurrogateManager:
         # rule).  arbitration='bandit': the plane is a credit-earning
         # VIRTUAL ARM in the driver's AUC bandit — pulled when its AUC
         # score wins, starved when its pulls stop producing new bests.
-        # Self-correcting where the static rule is all-or-nothing: the
-        # measured gcc-real harm (BENCHREPORT) came from unconditional
-        # pool tickets displacing bandit batches.
+        # Self-correcting where the schedule is unconditional; measured
+        # tradeoff in BENCHREPORT.md ("Bandit-arbitrated plane").
+        # Passivation stays orthogonal: the run-budget rule gates
+        # whether the plane is ACTIVE, arbitration only decides WHEN an
+        # active plane pulls.
         self.arbitration = arbitration
+        # Under bandit arbitration the pool batch is raised by the
+        # driver to the median technique-arm batch (pull-size parity,
+        # propose_batch_parity=False opts out).  Measured (r4,
+        # exp_bandit_batch.jsonl): 8-eval pool pulls inflate the AUC
+        # use_count ~4x faster per evaluation than ~32-eval technique
+        # batches, so once new bests thin out near the optimum the
+        # exploration term sqrt(2*log2(n)/use_count) ranks the plane
+        # last exactly when its refinement would finish the run —
+        # rosenbrock-4d censored 4/10 at batch 8, 4/10 at 16, 2/10 at
+        # 32 (median 2436 -> 1470 -> 414 vs scheduled 346).
+        self.propose_batch_parity = propose_batch_parity
         self.pool_mult = pool_mult
         self._pool_jit = None
         self.space = space
