@@ -605,7 +605,7 @@ GCC_REAL_ANALYSIS = """\
 
 Protocol v2 (both modes seeded with the declared-defaults -O2 trial,
 solved = 22% under the -O2 anchor, 80-eval budget, 10 matched seeds)
-measured four arms on the qsort payload:
+measured five arms on the qsort payload:
 
 | arm | median iters | IQR | censored |
 |---|---|---|---|
@@ -613,6 +613,18 @@ measured four arms on the qsort payload:
 | surrogate, in-loop guidance forced on (EI prune + pool) | 29 | 18-47 | 0/10 |
 | ...with the prune disabled (pool only) | 28 | 20-71 | 2/10 |
 | surrogate, shipping config (budget rule → passive here) | 18 | 14-26 | 1/10 |
+| surrogate, bandit arbitration (no budget rule, 8-eval pulls) | 18 | 14-26 | 0/10 |
+
+The fifth arm (r4, `exp_bandit_gccreal.jsonl`) is the adaptive answer
+to the same finding: arbitration='bandit' with the budget rule
+disabled and pull-size parity off.  The AUC credit does in-run what
+the static rule does a-priori — the plane gets tried after it fits,
+earns no new-best events on this landscape, and is starved — landing
+at the passive arm's median with the best solve-rate of any arm
+(10/10).  The static rule stays the shipping default (it spends zero
+evals learning what it already knows), but the bandit mode covers the
+regime the rule cannot see: budgets large enough to afford the plane
+on a landscape where it happens not to pay.
 
 Three observations pin the mechanism:
 

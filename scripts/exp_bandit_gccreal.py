@@ -1,0 +1,81 @@
+"""Experiment: can AUC credit limit in-run surrogate damage on gcc-real
+WITHOUT the static run-budget rule?
+
+The r3/r4 four-arm analysis (BENCHREPORT "Why the surrogate does not
+beat the bandit on gcc-real") measured forced-on in-loop guidance at 29
+median iters vs the seeded bandit's 19.5 — the plane's pool tickets
+displace scarce bandit batches on an 80-eval budget.  The shipping
+default passivates the plane there (run-budget rule, ratio 0.92).
+
+This arm measures the third option: arbitration='bandit' with
+auto_passive disabled and pull-size parity OFF (8-eval pulls are the
+affordable size on an 80-eval budget; parity would make each pull ~40%
+of the budget).  If the AUC credit works as designed, the bandit tries
+the plane once or twice after it fits (~16 evals in), sees no new
+bests, and starves it — landing between the seeded bandit (19.5) and
+forced-on (29), much closer to the former.
+
+Protocol matches benchreport gcc-real v2 exactly (same seeds, seeded
+declared-defaults trial, 22%-under-anchor threshold, budget 80); rows
+append to exp_bandit_gccreal.jsonl.  MUST run on an otherwise idle box:
+the objective is measured binary runtime.
+
+Usage: python scripts/exp_bandit_gccreal.py [--seeds N]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import cpuenv  # noqa: F401,E402  platform guard before jax
+
+import numpy as np  # noqa: E402
+
+from benchreport import one_run  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=10)
+    ap.add_argument("--state", default="exp_bandit_gccreal.jsonl")
+    args = ap.parse_args()
+
+    done = {}
+    if os.path.exists(args.state):
+        with open(args.state) as f:
+            for line in f:
+                r = json.loads(line)
+                done[r["seed"]] = r
+    out = open(args.state, "a")
+    rows = []
+    for s in range(args.seeds):
+        seed = 1000 + s
+        if seed in done:
+            rows.append(done[seed])
+            continue
+        r = one_run("gcc-real", "surrogate-bandit", seed=seed, budget=80,
+                    sopts_override={"propose_batch_parity": False})
+        r["seed"] = seed
+        rows.append(r)
+        out.write(json.dumps(r) + "\n")
+        out.flush()
+        import jax
+        jax.clear_caches()
+        print(f"  seed={s} iters={r['iters']}"
+              f"{' (censored)' if r['censored'] else ''}",
+              file=sys.stderr)
+    iters = np.asarray([r["iters"] for r in rows])
+    print(json.dumps({
+        "arm": "gcc-real surrogate-bandit (no budget rule, batch 8)",
+        "seeds": len(rows),
+        "median_iters": float(np.median(iters)),
+        "iqr": [float(np.percentile(iters, 25)),
+                float(np.percentile(iters, 75))],
+        "censored": int(sum(r["censored"] for r in rows))}))
+
+
+if __name__ == "__main__":
+    main()
