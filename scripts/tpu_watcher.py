@@ -56,14 +56,30 @@ def main() -> None:
         plat = probe()
         dt = time.monotonic() - t0
         if plat and plat not in ("cpu", "HUNG") and not plat.startswith("rc="):
-            log(f"attempt {attempt}: accelerator UP ({plat}, {dt:.1f}s) "
-                f"— running full bench")
-            env = dict(os.environ, UT_BENCH_PROBE_BUDGET_S="600")
+            have_std = os.path.exists(os.path.join(REPO, "BENCH_TPU.json"))
+            if not have_std:
+                log(f"attempt {attempt}: accelerator UP ({plat}, "
+                    f"{dt:.1f}s) — running full bench")
+                env = dict(os.environ, UT_BENCH_PROBE_BUDGET_S="600")
+                args = [sys.executable, os.path.join(REPO, "bench.py")]
+                want, done_msg = ('"platform": "tpu"',
+                                  "BENCH_TPU.json captured — watcher done")
+            else:
+                # standard artifact already banked this round: hunt the
+                # SCALED measurement instead (scale ladder, separate
+                # BENCH_TPU_SCALED.json — never overwrites the headline)
+                log(f"attempt {attempt}: accelerator UP ({plat}, "
+                    f"{dt:.1f}s) — standard artifact exists; running "
+                    f"scaled bench")
+                env = dict(os.environ)
+                args = [sys.executable,
+                        os.path.join(REPO, "scripts", "bench_scaled.py")]
+                want, done_msg = ('"platform": "tpu"',
+                                  "BENCH_TPU_SCALED.json captured — "
+                                  "watcher done")
             try:
-                r = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "bench.py")],
-                    capture_output=True, text=True, timeout=3600,
-                    cwd=REPO, env=env)
+                r = subprocess.run(args, capture_output=True, text=True,
+                                   timeout=3600, cwd=REPO, env=env)
             except subprocess.TimeoutExpired:
                 # the tunnel can wedge MID-RUN too; surviving that is
                 # this watcher's whole job — log and keep watching
@@ -74,8 +90,8 @@ def main() -> None:
             log(f"bench rc={r.returncode}")
             log(f"bench stdout: {r.stdout.strip()}")
             log(f"bench stderr tail: {r.stderr.strip()[-800:]}")
-            if r.returncode == 0 and '"platform": "tpu"' in r.stdout:
-                log("BENCH_TPU.json captured — watcher done")
+            if r.returncode == 0 and want in r.stdout:
+                log(done_msg)
                 return
             log("bench did not land on tpu (tunnel closed mid-run?); "
                 "continuing to watch")
