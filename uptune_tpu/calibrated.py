@@ -17,3 +17,10 @@ CALIBRATED_OPTS = {
     "score": "ei", "propose_batch": 8, "propose_every": 2,
     "pool_mult": 64,
 }
+
+# Not in the calibrated dict (the schedule is the measured default):
+# `arbitration='bandit'` turns the proposal plane into a credit-earning
+# virtual arm of the AUC bandit (driver applies pull-size parity to the
+# pool batch; the run-budget passivation rule still applies).  Opt in
+# via `ut --surrogate-arbitration bandit` or surrogate_opts; measured
+# tradeoffs in BENCHREPORT.md ("Bandit-arbitrated plane").
