@@ -1,0 +1,54 @@
+// Third gcc-options payload: integer 2-D stencil + reduction — the
+// SIMD-bound profile (vectorization, unrolling, ivopts flag territory),
+// complementing payload_qsort.cpp (branchy sort/search) and
+// mmm_block.cpp (cache-blocked matmul); fills the role of the
+// reference's loop-kernel gcc-options apps
+// (/root/reference/samples/gcc-options/src/).  All-integer arithmetic
+// so the output-equivalence gate stays exact under any legal transform
+// (a float stencil would change results under re-association and
+// poison the validity gate).  All arithmetic is UNSIGNED: int32 sums
+// here overflow by round 3, which would be UB — and the mined space
+// contains -ftrapv/-fwrapv, so overflow semantics genuinely vary by
+// config (r4 review) — while uint32 wraps identically under every
+// legal transform.  Deterministic; prints a checksum so the work
+// cannot be dead-coded away.
+#include <cstdint>
+#include <cstdio>
+#include <vector>
+
+static inline uint32_t lcg(uint32_t &s) {
+    s = s * 1664525u + 1013904223u;
+    return s;
+}
+
+int main() {
+    const int W = 1024, H = 768, ROUNDS = 240;
+    std::vector<uint32_t> a(W * H), b(W * H);
+    uint32_t seed = 987654321u;
+    for (auto &x : a) x = lcg(seed) & 0xffffu;
+    uint64_t checksum = 0;
+    for (int r = 0; r < ROUNDS; ++r) {
+        // 5-point integer stencil with shift/multiply mixing
+        for (int y = 1; y + 1 < H; ++y) {
+            const uint32_t *up = &a[(y - 1) * W], *mid = &a[y * W],
+                           *dn = &a[(y + 1) * W];
+            uint32_t *out = &b[y * W];
+            for (int x = 1; x + 1 < W; ++x) {
+                uint32_t v = 3u * mid[x] + up[x] + dn[x] + mid[x - 1]
+                             + mid[x + 1];
+                out[x] = (v >> 1) ^ (v << 3);
+            }
+        }
+        // mixing sweep (vectorizable per-row reductions)
+        uint64_t rowsum = 0;
+        for (int y = 1; y + 1 < H; ++y) {
+            const uint32_t *row = &b[y * W];
+            for (int x = 1; x + 1 < W; ++x)
+                rowsum += (row[x] * 2654435761u) >> 9;
+        }
+        checksum += rowsum;
+        a.swap(b);
+    }
+    std::printf("%llu\n", (unsigned long long)checksum);
+    return 0;
+}

@@ -156,13 +156,15 @@ _GCC_REAL_CACHE = {}
 def gcc_real_problem(payload: str = "qsort", budget: int = 80):
     """REAL g++ tuning (VERDICT r2 missing #3 / weak #4): the mined
     ~330-param space of samples/gcc-options/mine_gcc.py over actual
-    compiles + runs of the qsort payload on the installed compiler.
-    Solved = beating the plain `-O2` default build's best-of-3 wall time
-    by 15% (measured once per process, so every seed/mode in a sweep
-    chases the same anchor; the tuned optimum on this box is ~23% under
-    -O2, so 15% is reachable but takes real search).  Evaluation is serial real work (~2-4s per
-    config on this 1-core box) — run with --problems gcc-real and a
-    handful of seeds, not in the default synthetic sweep."""
+    compiles + runs of a real payload on the installed compiler —
+    'qsort' (branchy sort/search), 'mmm' (cache-blocked matmul), or
+    'stencil' (SIMD-bound integer stencil).  Solved = beating the plain
+    `-O2` default build's wall time by 22% (protocol v2: anchor
+    measured once per process so every seed/mode chases the same bar;
+    see the threshold comment below).  Evaluation is serial real work
+    (~2-4s per config on this 1-core box) — run with --problems
+    gcc-real[-mmm|-stencil] and a handful of seeds, not in the default
+    synthetic sweep."""
     import math
 
     if payload in _GCC_REAL_CACHE:
@@ -243,6 +245,10 @@ PROBLEMS = {
     # default sweep (real compiles; see gcc_real_problem docstring)
     "gcc-real": gcc_real_problem,
     "gcc-real-mmm": lambda: gcc_real_problem("mmm"),
+    # SIMD-bound integer stencil (payload_stencil.cpp): -O3/vectorizer
+    # flag territory, ~33% under -O2 reachable on this box (-O3
+    # -funroll-loops alone), so the 0.78x bar demands real flag search
+    "gcc-real-stencil": lambda: gcc_real_problem("stencil"),
 }
 DEFAULT_PROBLEMS = [p for p in PROBLEMS if not p.startswith("gcc-real")]
 
@@ -264,6 +270,7 @@ PROBLEM_BUDGETS = {
     "gcc-options": 6000,
     "gcc-real": 80,
     "gcc-real-mmm": 80,
+    "gcc-real-stencil": 80,
 }
 
 # Measurement-protocol version per problem: bumped whenever the way a
@@ -276,6 +283,11 @@ PROBLEM_BUDGETS = {
 PROBLEM_PROTO = {
     "gcc-real": "v2:seeded+0.78xO2",
     "gcc-real-mmm": "v2:seeded+0.78xO2",
+    # +u32: the payload's arithmetic went wrap-defined unsigned (r4
+    # review — int32 sums overflowed, UB, and -ftrapv configs aborted),
+    # changing both the anchor digest and the feasible set; rows
+    # measured against the UB-era source must not be reused
+    "gcc-real-stencil": "v2:seeded+0.78xO2+u32",
 }
 
 
@@ -679,7 +691,14 @@ pays (gcc-options: 1553 gated vs 1046.5 ungated 5-seed median), so the
 budget, not the dimension alone, is the discriminating variable.
 The mmm payload corroborates the budget argument from the other side:
 it solves in ≤7 median evals — before the surrogate would activate —
-so both modes measure identically (ratio 1.0).
+so both modes measure identically (ratio 1.0).  The third payload,
+gcc-real-stencil (SIMD-bound integer stencil, ~33% under -O2 reachable
+via the -O3/vectorizer flag family), lands the same way: 8 median
+evals, 10/10 solved in both modes (0.94) — across all three real
+optimization profiles (branchy search, cache-blocked matmul,
+vectorizable stencil), the seeded bandit solves the 22%-under-O2 bar
+inside or barely past its first batches, leaving a passive-plane
+surrogate mode at parity and no room where in-loop guidance could pay.
 """
 
 
