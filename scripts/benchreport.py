@@ -627,6 +627,12 @@ measured five arms on the qsort payload:
 | surrogate, shipping config (budget rule → passive here) | 18 | 14-26 | 1/10 |
 | surrogate, bandit arbitration (no budget rule, 8-eval pulls) | 18 | 14-26 | 0/10 |
 
+The table rows above carry the r4 30-matched-seed re-measurement of
+the first and fourth arms (fresh per-process anchor, measured tighter
+on an idler box, so absolute medians sit higher than this 10-seed
+table): baseline 28.5 vs shipping-surrogate 28.0 — ratio **0.98**,
+parity at triple the seeds.
+
 The fifth arm (r4, `exp_bandit_gccreal.jsonl`) is the adaptive answer
 to the same finding: arbitration='bandit' with the budget rule
 disabled and pull-size parity off.  The AUC credit does in-run what
