@@ -639,9 +639,11 @@ files):
 | surrogate, bandit arbitration (no budget rule, 8-eval pulls) | **25** | **2/30** |
 
 Parity between the first two holds at triple the seeds (0.98).  The
-bandit-arbitrated arm — `surrogate_opts=dict(arbitration='bandit',
-auto_passive=False, propose_batch_parity=False)`, i.e. let the AUC
-credit decide with affordable 8-eval pulls — is the best measured
+bandit-arbitrated arm — `uptune_tpu.calibrated.BUDGET_CONSTRAINED_OPTS`
+as `surrogate_opts` (CLI: `--learning-models gp
+--surrogate-arbitration bandit-small-budget`), i.e. the calibrated
+plane with the AUC credit deciding and affordable 8-eval pulls, no
+passivation — is the best measured
 configuration on this workload: **0.88× baseline** with the best
 solve-rate (28/30, `exp_bandit_gccreal_r4f.jsonl`).  Sparse
 credit-gated pool pulls add cheap diversity on the hard tail that the

@@ -103,6 +103,20 @@ class TestDriverWiring:
         assert not t._surr_arm
         assert any("bandit" in str(x.message) for x in w)
 
+    def test_budget_constrained_recipe(self):
+        """BUDGET_CONSTRAINED_OPTS (the measured gcc-real winner,
+        BENCHREPORT 30-seed table) wires bandit arbitration with
+        8-eval pulls and no passivation."""
+        from uptune_tpu.calibrated import BUDGET_CONSTRAINED_OPTS
+        space = Space([FloatParam(f"x{i}", 0, 1) for i in range(32)])
+        t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                  surrogate="gp",
+                  surrogate_opts=dict(BUDGET_CONSTRAINED_OPTS))
+        assert t._surr_arm
+        assert t.surrogate.propose_batch == 8   # parity off
+        t._apply_budget_rule(test_limit=5)      # 5 << 32 params
+        assert not t.surrogate.passive          # auto_passive off
+
     def test_budget_rule_orthogonal_to_arbitration(self):
         """The run-budget passivation rule gates whether the plane is
         ACTIVE in BOTH arbitration modes (a technique-batch-sized pool

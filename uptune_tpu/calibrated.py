@@ -24,3 +24,19 @@ CALIBRATED_OPTS = {
 # pool batch; the run-budget passivation rule still applies).  Opt in
 # via `ut --surrogate-arbitration bandit` or surrogate_opts; measured
 # tradeoffs in BENCHREPORT.md ("Bandit-arbitrated plane").
+
+# The measured recommendation for BUDGET-CONSTRAINED real-build tuning
+# (eval budget comparable to or below the parameter count, e.g. 80
+# compiles over a ~330-flag gcc space): let the AUC credit arbitrate
+# with affordable 8-eval pulls instead of passivating the plane.  At 30
+# matched seeds on gcc-real this is the best measured configuration —
+# median 25 iters vs baseline 28.5 (0.88x), solve-rate 28/30, vs the
+# passive rule's 28/4-censored (BENCHREPORT.md "Why the surrogate...",
+# exp_bandit_gccreal_r4f.jsonl).  CLI: --learning-models gp
+# --surrogate-arbitration bandit-small-budget.
+BUDGET_CONSTRAINED_OPTS = {
+    **CALIBRATED_OPTS,
+    "arbitration": "bandit",
+    "auto_passive": False,
+    "propose_batch_parity": False,
+}
