@@ -648,7 +648,10 @@ configuration on this workload: **0.88× baseline** with the best
 solve-rate (28/30, `exp_bandit_gccreal_r4f.jsonl`).  Sparse
 credit-gated pool pulls add cheap diversity on the hard tail that the
 always-on plane (29 median) turns into displacement damage and the
-passive plane forgoes.  The conservative default stands, but for
+passive plane forgoes.  On the fast-solving payloads the recipe is
+harmless by construction and by measurement (10 seeds each,
+`exp_recipe_safety.jsonl`): mmm 6.5 median vs 7 baseline, stencil 7
+vs 8, zero censored.  The conservative default stands, but for
 budget-constrained real-build tuning this recipe is the measured
 recommendation.
 
