@@ -482,9 +482,7 @@ class Tuner:
         saturation escape (r4 review).  Negative bandit feedback still
         flows from pulls that evaluate and fail to improve."""
         sm = self.surrogate
-        if (sm is None or not getattr(sm, "propose_batch", 0)
-                or not sm.fitted
-                or not math.isfinite(float(self.best.qor))):
+        if not self._surrogate_ready():
             return None
         self.key, k = jax.random.split(self.key)
         cands = sm.propose_pool(k, self.best.u, self.best.perms,
@@ -505,6 +503,14 @@ class Tuner:
             return None
         return tk
 
+    def _surrogate_ready(self) -> bool:
+        """Can the proposal plane emit a pool right now? (enabled,
+        fitted, and there is a finite incumbent to perturb around)"""
+        sm = self.surrogate
+        return (sm is not None and bool(getattr(sm, "propose_batch", 0))
+                and sm.fitted
+                and math.isfinite(float(self.best.qor)))
+
     def _acquire_surrogate(self) -> Optional[_Ticket]:
         """Scheduled surrogate proposal plane: every `propose_every`-th
         acquisition (once fitted) the manager emits its own batch
@@ -513,13 +519,10 @@ class Tuner:
         seeds), but IS attributed in the archive as 'surrogate'.  Under
         arbitration='bandit' this path is off — the AUC bandit pulls
         the plane as a virtual arm in _acquire instead."""
-        sm = self.surrogate
-        if (sm is None or not getattr(sm, "propose_batch", 0)
-                or not sm.fitted
-                or not math.isfinite(float(self.best.qor))):
+        if not self._surrogate_ready():
             return None
         self._surr_tick += 1
-        if self._surr_tick % max(1, sm.propose_every):
+        if self._surr_tick % max(1, self.surrogate.propose_every):
             return None
         return self._surrogate_ticket(credit=False)
 
@@ -580,7 +583,7 @@ class Tuner:
                           if (t if isinstance(t, str) else t.name)
                           not in dry]
                 order = active if active else order[:1]
-        if not any(not isinstance(t, str) for t in order):
+        if all(isinstance(t, str) for t in order):
             # every surviving entry is virtual: a failed virtual pull
             # must still leave a technique to fall back on
             order.append(self.members[0])
@@ -745,6 +748,20 @@ class Tuner:
             return self._finalize(tk)
         return None
 
+    def _credit(self, name: str, was_new_best: bool, live, global_best:
+                float) -> None:
+        """One AUC credit event for a resolved pull.  step_best comes
+        from the ticket's LIVE trials only: the batch qor also carries
+        history-dup rows served their recorded result, which would let
+        an arm that only re-proposes known configs inherit the
+        incumbent's QoR and dodge recycling."""
+        step_best = min((tr.qor for tr in live), default=float("inf"))
+        if self._credit_kw:
+            self.root.credit(name, was_new_best, step_best=step_best,
+                             global_best=global_best)
+        else:
+            self.root.credit(name, was_new_best)
+
     def _finalize(self, tk: _Ticket) -> StepStats:
         """Commit a completed ticket: history insert, best update,
         archive rows, technique observe + bandit credit."""
@@ -793,19 +810,7 @@ class Tuner:
             # flight — observing would write the pre-restart snapshot
             # back over the fresh state, silently undoing the restart
             if isinstance(self.root, MetaTechnique):
-                # window-best from the ticket's LIVE trials only: the
-                # batch qor also carries history-dup rows served their
-                # recorded result, which would let a member that only
-                # re-proposes known configs inherit the incumbent's QoR
-                # and dodge recycling
-                step_best = min((tr.qor for tr in live),
-                                default=float("inf"))
-                if self._credit_kw:
-                    self.root.credit(tk.arm.name, was_new_best,
-                                     step_best=step_best,
-                                     global_best=new)
-                else:
-                    self.root.credit(tk.arm.name, was_new_best)
+                self._credit(tk.arm.name, was_new_best, live, new)
                 # quality-aware metas (RecyclingMeta) may ask for member
                 # restarts: re-initialize the member's device state (the
                 # jitted programs are keyed by name and stay cached)
@@ -818,12 +823,7 @@ class Tuner:
         elif tk.credit_virtual and isinstance(self.root, MetaTechnique):
             # bandit-arbitrated surrogate pull: no technique state to
             # observe, but the outcome is the virtual arm's AUC event
-            step_best = min((tr.qor for tr in live), default=float("inf"))
-            if self._credit_kw:
-                self.root.credit(tk.arm_name, was_new_best,
-                                 step_best=step_best, global_best=new)
-            else:
-                self.root.credit(tk.arm_name, was_new_best)
+            self._credit(tk.arm_name, was_new_best, live, new)
         if was_new_best:
             self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])[2] += 1
         dropped = int(self.hist_state.dropped)
