@@ -109,10 +109,14 @@ def _probe_accelerator(budget_s: float) -> str:
         probe_timeout = min(probe_timeout * 2, 300.0)
 
 
-def _init_backend(cpu_flag: bool, wait_for_tpu: bool):
-    """Import jax and return (jax, platform_name).  Never hangs: the
-    accelerator is probed in killable subprocesses first; on failure we
-    fall back to CPU with an explicit label."""
+def _init_backend(cpu_flag: bool, wait_for_tpu: bool, budget_s=None):
+    """Import jax and return (jax, platform_name).  Never hangs on the
+    probe: the accelerator is checked in killable subprocesses first;
+    on failure we fall back to CPU with an explicit label.  (A tunnel
+    that wedges in the window between a successful probe and the
+    in-process backend init can still block — irreducible for any
+    check that must actually run on the accelerator.)  `budget_s`
+    overrides the probe budget (also used by __graft_entry__.entry)."""
     from uptune_tpu.utils.platform_guard import force_cpu
 
     if cpu_flag:
@@ -123,7 +127,8 @@ def _init_backend(cpu_flag: bool, wait_for_tpu: bool):
     # default sized so probe + quick CPU fallback stays well inside the
     # driver's bench step budget (commit e470740's concern): ~4 min of
     # probing, then the fallback still produces its labeled JSON line
-    budget = float(os.environ.get("UT_BENCH_PROBE_BUDGET_S", "240"))
+    budget = (float(budget_s) if budget_s is not None
+              else float(os.environ.get("UT_BENCH_PROBE_BUDGET_S", "240")))
     if wait_for_tpu:
         budget = max(budget, 3 * 3600.0)
     plat = _probe_accelerator(budget)

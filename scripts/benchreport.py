@@ -809,13 +809,24 @@ if __name__ == "__main__":
         if dropped:
             # excluded rows are preserved, not destroyed: a --quick
             # invocation pointed at the published rows file must never
-            # delete the 30-seed sweep results it mismatches
-            with open(args.rows + ".stale", "a") as f:
+            # delete the 30-seed sweep results it mismatches.  Append
+            # only rows not already preserved — every re-generation
+            # supersedes the same aggregates, and blind appends tripled
+            # rows in the archive (r4 review)
+            stale_path = args.rows + ".stale"
+            have = set()
+            if os.path.exists(stale_path):
+                with open(stale_path) as f:
+                    have = {line.rstrip("\n") for line in f}
+            with open(stale_path, "a") as f:
                 for r in dropped:
-                    f.write(json.dumps(r) + "\n")
+                    line = json.dumps(r)
+                    if line not in have:
+                        f.write(line + "\n")
+                        have.add(line)
                     print(f"rows: excluded {r['problem']}/{r['mode']} "
                           f"(budget/settings mismatch or superseded); "
-                          f"preserved in {args.rows}.stale",
+                          f"preserved in {stale_path}",
                           file=sys.stderr)
         rows = kept + rows
         order = {p: i for i, p in enumerate(PROBLEMS)}
