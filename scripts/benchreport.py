@@ -324,7 +324,12 @@ def _norm_mode(m: str) -> str:
 
 
 def one_run(problem: str, mode: str, seed: int, budget: int,
-            sopts_override: dict = None):
+            sopts_override: dict = None, archive: str = None,
+            stop_at_target: bool = True):
+    """`archive` records every trial to a driver jsonl (the
+    cross-payload screening experiments mine these);
+    `stop_at_target=False` runs the full budget even after the
+    threshold is reached (more archive rows per run)."""
     from uptune_tpu.driver.driver import Tuner
 
     mode = _norm_mode(mode)
@@ -352,7 +357,7 @@ def one_run(problem: str, mode: str, seed: int, budget: int,
         if sopts_override:
             sopts.update(sopts_override)
     tuner = Tuner(space, objective, seed=seed, surrogate=surrogate,
-                  surrogate_opts=sopts)
+                  surrogate_opts=sopts, archive=archive)
     t0 = time.time()
     # seed trials (identical for every mode): library-mode analogue of
     # the CLI's declared-defaults seed (exec/controller.py seed trial)
@@ -361,7 +366,8 @@ def one_run(problem: str, mode: str, seed: int, budget: int,
         for tr_ in tuner.inject(seed_cfgs, "seed"):
             tuner.tell(tr_, float(np.asarray(
                 objective([tr_.config])).reshape(-1)[0]))
-    res = tuner.run(test_limit=budget, target=thresh)
+    res = tuner.run(test_limit=budget,
+                    target=thresh if stop_at_target else None)
     wall = time.time() - t0
     tuner.close()
     it = iters_to_threshold(res.trace, thresh, budget)
@@ -378,10 +384,19 @@ def one_run(problem: str, mode: str, seed: int, budget: int,
     return row
 
 
-def _sopts_sig(mode: str):
+def _sopts_sig(mode: str, problem: str = ""):
     """Fingerprint of the settings a cached row was measured under."""
     mode = _norm_mode(mode)
     if mode == "surrogate":
+        # budget_rule=v2: the driver's small-budget rule now applies
+        # the bandit-arbitrated recipe instead of passivating (r5).
+        # Only the gcc-real problems run in that regime (budget 80 <
+        # ~330 params), so only THEIR pre-v2 "surrogate" rows changed
+        # meaning; the synthetic sweeps (budget >> params, rule never
+        # engages) keep their cached 30-seed rows
+        if problem.startswith("gcc-real"):
+            return json.dumps(dict(SURROGATE_SOPTS, budget_rule="v2"),
+                              sort_keys=True)
         return json.dumps(SURROGATE_SOPTS, sort_keys=True)
     if mode == "surrogate-bandit":
         # propose_batch_parity is a DRIVER behavior (pool batch raised
@@ -433,7 +448,7 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
                 # table, and rows measured under older TPU_SOPTS must
                 # not be reported as the current mode's numbers (legacy
                 # rows without the fields are always re-run)
-                sig = _sopts_sig(mode)
+                sig = _sopts_sig(mode, prob)
                 proto = PROBLEM_PROTO.get(prob)
                 if cached is not None and \
                         cached.get("budget") == budget and \
@@ -465,7 +480,7 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
             iters = np.asarray([r["iters"] for r in per_seed])
             rows.append({
                 "problem": prob, "mode": mode, "seeds": seeds,
-                "budget": budget, "sopts_sig": _sopts_sig(mode),
+                "budget": budget, "sopts_sig": _sopts_sig(mode, prob),
                 "proto": PROBLEM_PROTO.get(prob),
                 "median_iters": float(np.median(iters)),
                 "iqr": [float(np.percentile(iters, 25)),
@@ -801,7 +816,7 @@ if __name__ == "__main__":
             cur_budget = (int(PROBLEM_BUDGETS[r["problem"]] * scale)
                           if r["problem"] in PROBLEM_BUDGETS else None)
             if (r.get("budget") != cur_budget
-                    or r.get("sopts_sig") != _sopts_sig(r["mode"])
+                    or r.get("sopts_sig") != _sopts_sig(r["mode"], r["problem"])
                     or r.get("proto") != PROBLEM_PROTO.get(r["problem"])):
                 dropped.append(r)
             else:

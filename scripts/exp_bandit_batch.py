@@ -42,33 +42,33 @@ def main():
             for line in f:
                 r = json.loads(line)
                 done[(r["batch"], r["seed"])] = r
-    out = open(args.state, "a")
-    for batch in args.batches:
-        rows = []
-        for s in range(args.seeds):
-            key = (batch, 1000 + s)
-            if key in done:
-                rows.append(done[key])
-                continue
-            r = one_run("rosenbrock-4d", "surrogate-bandit",
-                        seed=1000 + s, budget=4000,
-                        sopts_override={"propose_batch": batch})
-            r.update({"batch": batch, "seed": 1000 + s})
-            rows.append(r)
-            out.write(json.dumps(r) + "\n")
-            out.flush()
-            import jax
-            jax.clear_caches()
-            print(f"  batch={batch} seed={s} iters={r['iters']}"
-                  f"{' (censored)' if r['censored'] else ''}",
-                  file=sys.stderr)
-        iters = np.asarray([r["iters"] for r in rows])
-        print(json.dumps({
-            "batch": batch, "seeds": args.seeds,
-            "median_iters": float(np.median(iters)),
-            "iqr": [float(np.percentile(iters, 25)),
-                    float(np.percentile(iters, 75))],
-            "censored": int(sum(r["censored"] for r in rows))}))
+    with open(args.state, "a") as out:
+        for batch in args.batches:
+            rows = []
+            for s in range(args.seeds):
+                key = (batch, 1000 + s)
+                if key in done:
+                    rows.append(done[key])
+                    continue
+                r = one_run("rosenbrock-4d", "surrogate-bandit",
+                            seed=1000 + s, budget=4000,
+                            sopts_override={"propose_batch": batch})
+                r.update({"batch": batch, "seed": 1000 + s})
+                rows.append(r)
+                out.write(json.dumps(r) + "\n")
+                out.flush()
+                import jax
+                jax.clear_caches()
+                print(f"  batch={batch} seed={s} iters={r['iters']}"
+                      f"{' (censored)' if r['censored'] else ''}",
+                      file=sys.stderr)
+            iters = np.asarray([r["iters"] for r in rows])
+            print(json.dumps({
+                "batch": batch, "seeds": args.seeds,
+                "median_iters": float(np.median(iters)),
+                "iqr": [float(np.percentile(iters, 25)),
+                        float(np.percentile(iters, 75))],
+                "censored": int(sum(r["censored"] for r in rows))}))
 
 
 if __name__ == "__main__":

@@ -49,24 +49,25 @@ def main():
             for line in f:
                 r = json.loads(line)
                 done[r["seed"]] = r
-    out = open(args.state, "a")
     rows = []
-    for s in range(args.seeds):
-        seed = 1000 + s
-        if seed in done:
-            rows.append(done[seed])
-            continue
-        r = one_run("gcc-real", "surrogate-bandit", seed=seed, budget=80,
-                    sopts_override={"propose_batch_parity": False})
-        r["seed"] = seed
-        rows.append(r)
-        out.write(json.dumps(r) + "\n")
-        out.flush()
-        import jax
-        jax.clear_caches()
-        print(f"  seed={s} iters={r['iters']}"
-              f"{' (censored)' if r['censored'] else ''}",
-              file=sys.stderr)
+    with open(args.state, "a") as out:
+        for s in range(args.seeds):
+            seed = 1000 + s
+            if seed in done:
+                rows.append(done[seed])
+                continue
+            r = one_run("gcc-real", "surrogate-bandit", seed=seed,
+                        budget=80,
+                        sopts_override={"propose_batch_parity": False})
+            r["seed"] = seed
+            rows.append(r)
+            out.write(json.dumps(r) + "\n")
+            out.flush()
+            import jax
+            jax.clear_caches()
+            print(f"  seed={s} iters={r['iters']}"
+                  f"{' (censored)' if r['censored'] else ''}",
+                  file=sys.stderr)
     iters = np.asarray([r["iters"] for r in rows])
     print(json.dumps({
         "arm": "gcc-real surrogate-bandit (no budget rule, batch 8)",

@@ -1,0 +1,154 @@
+"""Experiment: cross-payload feature screening on REAL g++ tuning
+(r4 verdict next-step #3).
+
+The r4 diagnosis: on gcc-real (80 evals, ~330 params -> ~1,100 one-hot
+lanes) the GP stays prior-dominated; the best measured arm (bandit
+arbitration, 8-eval pulls) reached 0.88x baseline.  The attack here is
+TRANSFER: mine per-flag sensitivity from full-budget archives of the
+OTHER payloads over the same mined space, restrict the surrogate to the
+top-k lanes (surrogate/screen.py), and bias the proposal plane's flip
+moves toward flags that measurably moved runtime elsewhere.
+
+Phases (each resumable via its jsonl state):
+  archives — full-80-eval baseline runs per payload, trials recorded to
+             exp_archives/gccreal_<payload>_<seed>.jsonl
+  run      — the screened surrogate-bandit arm on a target payload,
+             screen built from the OTHER payloads' archives; protocol
+             matches benchreport gcc-real v2 (same seeds 1000+, seeded
+             -O2 trial, 0.78x-anchor threshold, budget 80)
+
+Usage:
+  python scripts/exp_screen_gccreal.py archives [--payloads qsort,mmm,stencil]
+  python scripts/exp_screen_gccreal.py run --target qsort [--seeds 30]
+      [--top 16,24] [--state exp_screen_gccreal.jsonl]
+
+MUST run on an otherwise idle box: the objective is measured binary
+runtime.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import cpuenv  # noqa: F401,E402  platform guard before jax
+
+import numpy as np  # noqa: E402
+
+from benchreport import PROBLEMS, one_run  # noqa: E402
+
+PAYLOADS = ("qsort", "mmm", "stencil")
+ARCH_DIR = "exp_archives"
+ARCH_SEEDS = (2000, 2001, 2002)
+
+
+def _prob_name(payload: str) -> str:
+    return "gcc-real" if payload == "qsort" else f"gcc-real-{payload}"
+
+
+def _arch_path(payload: str, seed: int) -> str:
+    return os.path.join(ARCH_DIR, f"gccreal_{payload}_{seed}.jsonl")
+
+
+def gen_archives(payloads) -> None:
+    os.makedirs(ARCH_DIR, exist_ok=True)
+    for payload in payloads:
+        for seed in ARCH_SEEDS:
+            path = _arch_path(payload, seed)
+            if os.path.exists(path) and os.path.getsize(path):
+                print(f"  {path}: exists, skipping", file=sys.stderr)
+                continue
+            r = one_run(_prob_name(payload), "baseline", seed=seed,
+                        budget=80, archive=path, stop_at_target=False)
+            print(f"  {payload} seed={seed} rows->{path} "
+                  f"best={r['best']:.4f}", file=sys.stderr)
+            import jax
+            jax.clear_caches()
+
+
+def run_screened(target: str, seeds: int, top: str, state_path: str,
+                 flip_only: bool = False) -> None:
+    from uptune_tpu.surrogate.screen import screen_from_archives
+
+    top_cont, top_cat = (int(x) for x in top.split(","))
+    prob = _prob_name(target)
+    space = PROBLEMS[prob]()[0]   # also measures the anchor (cached)
+    others = [p for p in PAYLOADS if p != target]
+    paths = [_arch_path(p, s) for p in others for s in ARCH_SEEDS]
+    sc = screen_from_archives(space, paths, top_cont=top_cont,
+                              top_cat=top_cat)
+    if sc is None:
+        print("no archives found — run the 'archives' phase first",
+              file=sys.stderr)
+        sys.exit(1)
+    n_src = sum(1 for p in paths if os.path.exists(p))
+    arm = f"screen-{top}" + ("-fliponly" if flip_only else "")
+    print(f"screen for {target}: {n_src} source archives from "
+          f"{others}, kept {sc.n_cont} cont lanes + {sc.n_cat} groups "
+          f"({len(sc.idx)} of {space.n_surrogate_features} lanes)",
+          file=sys.stderr)
+
+    done = {}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            for line in f:
+                r = json.loads(line)
+                done[(r["target"], r["arm"], r["seed"])] = r
+    rows = []
+    if flip_only:
+        # ablation: keep the full-width GP, only bias the flip moves
+        sc = sc._replace(idx=np.arange(space.n_surrogate_features,
+                                       dtype=np.int32),
+                         n_cont=space.n_cont_features,
+                         n_cat=space.n_cat)
+    with open(state_path, "a") as out:
+        for s in range(seeds):
+            seed = 1000 + s
+            key = (target, arm, seed)
+            if key in done:
+                rows.append(done[key])
+                continue
+            r = one_run(prob, "surrogate-bandit", seed=seed, budget=80,
+                        sopts_override={"propose_batch_parity": False,
+                                        "screen": sc})
+            r.update({"target": target, "arm": arm, "seed": seed})
+            rows.append(r)
+            out.write(json.dumps(r) + "\n")
+            out.flush()
+            import jax
+            jax.clear_caches()
+            print(f"  {target} {arm} seed={s} iters={r['iters']}"
+                  f"{' (censored)' if r['censored'] else ''}",
+                  file=sys.stderr)
+    iters = np.asarray([r["iters"] for r in rows])
+    print(json.dumps({
+        "arm": f"{target} {arm} (bandit, batch 8, screened)",
+        "seeds": len(rows),
+        "median_iters": float(np.median(iters)),
+        "iqr": [float(np.percentile(iters, 25)),
+                float(np.percentile(iters, 75))],
+        "censored": int(sum(r["censored"] for r in rows))}))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("phase", choices=("archives", "run"))
+    ap.add_argument("--payloads", default=",".join(PAYLOADS))
+    ap.add_argument("--target", default="qsort", choices=PAYLOADS)
+    ap.add_argument("--seeds", type=int, default=10)
+    ap.add_argument("--top", default="16,24")
+    ap.add_argument("--flip-only", action="store_true",
+                    help="ablation: full-width GP, screened flip bias")
+    ap.add_argument("--state", default="exp_screen_gccreal.jsonl")
+    args = ap.parse_args()
+    if args.phase == "archives":
+        gen_archives([p for p in args.payloads.split(",") if p])
+    else:
+        run_screened(args.target, args.seeds, args.top, args.state,
+                     flip_only=args.flip_only)
+
+
+if __name__ == "__main__":
+    main()

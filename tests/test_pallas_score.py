@@ -108,3 +108,135 @@ class TestMixedKernelScores:
                             n_cat=n_cat)
         np.testing.assert_allclose(np.asarray(mu), np.asarray(mu_ref),
                                    rtol=1e-4, atol=1e-5)
+
+
+from uptune_tpu.surrogate.pallas_score import (  # noqa: E402
+    PALLAS_MIN_POOL, VTILE, gp_mean_var_scores)
+
+
+def _mixed_data(rng, n, n_cont, n_cat, K):
+    codes = rng.randint(K, size=(n, n_cat))
+    oh = np.zeros((n, n_cat, K), np.float32)
+    np.put_along_axis(oh, codes[:, :, None], 1.0, axis=2)
+    x = np.concatenate([rng.rand(n, n_cont).astype(np.float32),
+                        oh.reshape(n, -1) / np.sqrt(2)], axis=1)
+    y = (x[:, 0] * 2 + 3.0 * (codes[:, 1] == 0)
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    return x, y
+
+
+class TestMeanVarScores:
+    """The fused mean+VARIANCE path (K^-1 quadratic-form tiling): EI
+    and LCB become exact in the Pallas regime, not just the mean."""
+
+    def _check(self, st, xq, n_cont=None, n_cat=0):
+        mu_ref, sd_ref = gp.predict(st, xq, n_cont, n_cat)
+        mu, sd = gp_mean_var_scores(st, xq, interpret=True,
+                                    n_cont=n_cont, n_cat=n_cat)
+        np.testing.assert_allclose(np.asarray(mu), np.asarray(mu_ref),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(sd), np.asarray(sd_ref),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_matches_xla_predict(self, fitted):
+        rng = np.random.RandomState(11)
+        self._check(fitted, jnp.asarray(rng.rand(VTILE, 12), jnp.float32))
+
+    def test_ragged_batch(self, fitted):
+        rng = np.random.RandomState(12)
+        self._check(fitted, jnp.asarray(rng.rand(53, 12), jnp.float32))
+
+    def test_masked_state_matches_unpadded(self):
+        """The premasked K^-1 must make padded training rows inert in
+        BOTH moments (block-diagonal argument, module docstring)."""
+        rng = np.random.RandomState(13)
+        x = jnp.asarray(rng.rand(40, 6), jnp.float32)
+        y = jnp.asarray(rng.randn(40), jnp.float32)
+        xq = jnp.asarray(rng.rand(16, 6), jnp.float32)
+        s0 = gp.fit(x, y, 0.5, 1e-2)
+        xp = jnp.concatenate([x, jnp.zeros((24, 6))])
+        yp = jnp.concatenate([y, jnp.zeros(24)])
+        mask = jnp.concatenate([jnp.ones(40), jnp.zeros(24)])
+        s1 = gp.fit(xp, yp, 0.5, 1e-2, mask)
+        m0, v0 = gp_mean_var_scores(s0, xq, interpret=True)
+        m1, v1 = gp_mean_var_scores(s1, xq, interpret=True)
+        np.testing.assert_allclose(np.asarray(m0), np.asarray(m1),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(v0), np.asarray(v1),
+                                   rtol=1e-3, atol=1e-5)
+
+    def test_mixed_kernel(self):
+        rng = np.random.RandomState(14)
+        x, y = _mixed_data(rng, 96, 5, 4, 3)
+        st = gp.fit(jnp.asarray(x), jnp.asarray(y), 0.4, 1e-2,
+                    n_cont=5, n_cat=4, ls_cat=0.2)
+        self._check(st, jnp.asarray(x[:64]), n_cont=5, n_cat=4)
+
+    def test_all_categorical(self):
+        rng = np.random.RandomState(15)
+        x, y = _mixed_data(rng, 80, 0, 6, 3)
+        st = gp.fit(jnp.asarray(x), jnp.asarray(y), 0.4, 1e-2,
+                    n_cont=0, n_cat=6, ls_cat=0.3)
+        self._check(st, jnp.asarray(x[:48]), n_cont=0, n_cat=6)
+
+
+class TestManagerPallasRegime:
+    """r4 verdict next-step #2 'done' bar: via the PUBLIC manager API,
+    on a >= 4096-candidate pool, the Pallas-scored top-k equals the
+    plain-XLA top-k."""
+
+    def test_pool_topk_matches_xla(self, monkeypatch):
+        import uptune_tpu.surrogate.pallas_score as ps
+        from uptune_tpu.surrogate import SurrogateManager
+        from uptune_tpu.workloads import (rosenbrock_device,
+                                          rosenbrock_space)
+
+        space = rosenbrock_space(4, -2.0, 2.0)
+
+        def fitted_manager():
+            # propose_batch 64 x pool_mult 64 = 4096-candidate pool
+            m = SurrogateManager(space, "gp", min_points=48,
+                                 propose_batch=64, pool_mult=64,
+                                 score="ei", seed=3)
+            cands = space.random(jax.random.PRNGKey(3), 64)
+            qor = np.asarray(
+                rosenbrock_device(space.decode_scalars(cands.u)))
+            m.observe(np.asarray(space.features(cands)), qor)
+            assert m.maybe_refit()
+            return m, float(qor.min()), cands
+
+        m_pl, best, cands = fitted_manager()
+        assert 64 * m_pl.pool_mult >= PALLAS_MIN_POOL
+        out_pl = m_pl.propose_pool(jax.random.PRNGKey(7), cands.u[0],
+                                   (), best)
+        # identical manager, Pallas regime disabled
+        monkeypatch.setattr(ps, "PALLAS_MIN_POOL", 1 << 30)
+        m_xla, best2, cands2 = fitted_manager()
+        assert best2 == best
+        out_xla = m_xla.propose_pool(jax.random.PRNGKey(7),
+                                     cands2.u[0], (), best)
+        np.testing.assert_allclose(np.asarray(out_pl.u),
+                                   np.asarray(out_xla.u),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestShardedPallasRegime:
+    def test_sharded_pallas_matches_xla(self):
+        """parallel/surrogate_shard.py: forcing the per-shard Pallas
+        path must reproduce the XLA scores for mean/ei/lcb."""
+        from uptune_tpu.parallel import make_mesh
+        from uptune_tpu.parallel.surrogate_shard import sharded_gp_score
+
+        rng = np.random.RandomState(21)
+        x = jnp.asarray(rng.rand(64, 8), jnp.float32)
+        y = jnp.asarray(rng.randn(64), jnp.float32)
+        st = gp.fit(x, y, 0.4, 1e-2)
+        pool = jnp.asarray(rng.rand(128, 8), jnp.float32)
+        mesh = make_mesh(n_search=1, n_eval=8)
+        for kind in ("mean", "ei", "lcb"):
+            a = sharded_gp_score(mesh, "eval", st, pool, kind=kind,
+                                 best_y=0.0, use_pallas=False)
+            b = sharded_gp_score(mesh, "eval", st, pool, kind=kind,
+                                 best_y=0.0, use_pallas=True)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-5)

@@ -1,0 +1,186 @@
+"""Cross-payload feature screening (surrogate/screen.py): per-lane
+sensitivity transfer from archives of other payloads over the same
+space, restricting the SURROGATE's view (never the techniques') to the
+lanes that measurably moved QoR — the r4-verdict attack on the
+prior-dominated-GP regime (80 evals over ~1,100 one-hot lanes).
+Reference analogue: none — its XGBoost plugin relied on tree splits to
+ignore dead features and archives were only replayed for resume
+(/root/reference/python/uptune/api.py:328-363)."""
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from uptune_tpu.driver import Tuner
+from uptune_tpu.space.params import BoolParam, EnumParam, FloatParam
+from uptune_tpu.space.spec import Space
+from uptune_tpu.surrogate import SurrogateManager
+from uptune_tpu.surrogate.screen import (FeatureScreen, archive_rows,
+                                         build_screen, lane_sensitivity,
+                                         screen_from_archives)
+
+
+def _space(n_float=4, n_bool=12, n_enum=3):
+    return Space([FloatParam(f"x{i}", 0.0, 1.0) for i in range(n_float)]
+                 + [BoolParam(f"f{i}") for i in range(n_bool)]
+                 + [EnumParam(f"e{i}", ("a", "b", "c"))
+                    for i in range(n_enum)])
+
+
+def _payload_data(space, n=200, seed=0, live_f=(0, 3), live_x=(1,)):
+    """(surrogate feats, qor) where only the named params move QoR."""
+    cands = space.random(jax.random.PRNGKey(seed), n)
+    feats = np.asarray(space.surrogate_transform(space.features(cands)))
+    cfgs = space.to_configs(cands)
+    qor = np.zeros(n)
+    for r, c in enumerate(cfgs):
+        qor[r] = (sum((2.0 + i) * float(c[f"f{i}"]) for i in live_f)
+                  + sum(3.0 * c[f"x{i}"] for i in live_x)
+                  + 0.01 * np.random.RandomState(seed * 1000 + r).rand())
+    return feats, qor
+
+
+class TestSensitivity:
+    def test_live_lanes_outrank_dead(self):
+        space = _space()
+        feats, qor = _payload_data(space)
+        s = lane_sensitivity(feats, qor)
+        assert s.shape == (space.n_surrogate_features,)
+        nc, w = space.n_cont_features, space.cat_max_codes
+        # group scores: live flags f0/f3 (groups 0 and 3) beat all the
+        # dead flags and enums
+        gs = s[nc:].reshape(space.n_cat, w).max(axis=1)
+        dead = [g for g in range(space.n_cat) if g not in (0, 3)]
+        assert gs[0] > max(gs[d] for d in dead)
+        assert gs[3] > max(gs[d] for d in dead)
+        # live numeric lane x1 beats the dead numeric lanes
+        assert s[1] > max(s[0], s[2], s[3])
+
+    def test_nonfinite_rows_dropped(self):
+        space = _space()
+        feats, qor = _payload_data(space)
+        qor[::3] = np.inf
+        s = lane_sensitivity(feats, qor)
+        assert np.isfinite(s).all()
+
+
+class TestBuildScreen:
+    def test_layout_and_selection(self):
+        space = _space()
+        sources = [_payload_data(space, seed=s) for s in range(3)]
+        sc = build_screen(space, sources, top_cont=2, top_cat=4)
+        assert isinstance(sc, FeatureScreen)
+        assert sc.n_cont == 2 and sc.n_cat == 4
+        nc, w = space.n_cont_features, space.cat_max_codes
+        assert len(sc.idx) == 2 + 4 * w
+        # cont block first (indices < n_cont), then whole one-hot
+        # groups, everything strictly increasing within its block
+        cont, cat = sc.idx[:2], sc.idx[2:]
+        assert (cont < nc).all() and (cat >= nc).all()
+        assert (np.diff(cont) > 0).all() and (np.diff(cat) > 0).all()
+        # the live lanes made the cut
+        assert 1 in cont                       # x1
+        groups = sorted(set((cat - nc) // w))
+        assert 0 in groups and 3 in groups     # f0, f3
+        # flip weights live only on kept categorical scalar lanes
+        lanes = np.asarray(space.cat_lane_idx)[groups]
+        assert (sc.cat_weight[lanes] > 0).any()
+        off = np.ones(space.n_scalar, bool)
+        off[lanes] = False
+        assert (sc.cat_weight[off] == 0).all()
+
+    def test_apply_projects(self):
+        space = _space()
+        sc = build_screen(space, [_payload_data(space)], top_cont=2,
+                          top_cat=4)
+        feats, _ = _payload_data(space, seed=9)
+        assert sc.apply(feats).shape == (feats.shape[0], len(sc.idx))
+
+
+class TestManagerIntegration:
+    def test_screened_manager_fit_prune_propose(self):
+        space = _space()
+        sc = build_screen(space, [_payload_data(space, seed=s)
+                                  for s in range(2)],
+                          top_cont=2, top_cat=4)
+        m = SurrogateManager(space, "gp", min_points=32,
+                             propose_batch=8, pool_mult=8, screen=sc,
+                             select="topk", score="ei")
+        cands = space.random(jax.random.PRNGKey(5), 64)
+        feats, qor = _payload_data(space, seed=5, n=64)
+        m.observe(np.asarray(space.features(cands)), qor[:64])
+        assert m.maybe_refit()
+        # the GP was fitted on the SCREENED width
+        assert m._state.x.shape[1] == len(sc.idx)
+        keep = m.keep_mask(cands)
+        assert keep is not None and keep.shape == (64,)
+        pool = m.propose_pool(jax.random.PRNGKey(6), cands.u[0], (),
+                              float(qor.min()))
+        assert pool is not None and pool.batch == 8
+
+    def test_screen_dict_form_builds_from_archives(self, tmp_path):
+        """The CLI hands {'archives': [...]} through surrogate_opts;
+        the manager builds the screen once the space exists."""
+        space = _space()
+        arch = str(tmp_path / "src.jsonl")
+        cfg_live = [0, 3]
+
+        def obj(cfgs):
+            return [sum((2.0 + i) * float(c[f"f{i}"]) for i in cfg_live)
+                    + 3.0 * c["x1"] for c in cfgs]
+
+        t = Tuner(space, obj, seed=0, archive=arch)
+        t.run(test_limit=120)
+        t.close()
+        m = SurrogateManager(space, "gp",
+                             screen={"archives": [arch],
+                                     "top_cont": 2, "top_cat": 4})
+        assert m.screen is not None
+        assert m.screen.n_cont == 2 and m.screen.n_cat == 4
+        # missing/empty archives -> unscreened, not an error
+        m2 = SurrogateManager(space, "gp",
+                              screen={"archives":
+                                      [str(tmp_path / "nope.jsonl")]})
+        assert m2.screen is None
+
+    def test_archive_space_mismatch_raises(self, tmp_path):
+        space = _space()
+        other = _space(n_float=3)
+        arch = str(tmp_path / "a.jsonl")
+        t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                  archive=arch)
+        t.run(test_limit=20)
+        t.close()
+        with pytest.raises(ValueError, match="different space"):
+            archive_rows(other, arch)
+
+    def test_screened_beats_unscreened_ranking(self):
+        """On a mostly-dead space with 48 observations, the screened
+        GP's posterior mean must rank a large candidate set better
+        than the unscreened one (the whole point of the transfer)."""
+        from uptune_tpu.surrogate import gp as gp_mod
+
+        space = _space(n_float=4, n_bool=24, n_enum=6)
+        sources = [_payload_data(space, seed=s) for s in range(3)]
+        sc = build_screen(space, sources, top_cont=2, top_cat=4)
+        feats, qor = _payload_data(space, seed=7, n=48)
+        test_f, test_q = _payload_data(space, seed=8, n=256)
+
+        def spearman(a, b):
+            ra = np.argsort(np.argsort(a)).astype(float)
+            rb = np.argsort(np.argsort(b)).astype(float)
+            return np.corrcoef(ra, rb)[0, 1]
+
+        rhos = {}
+        for name, idx, ncont, ncat in (
+                ("screened", sc.idx, sc.n_cont, sc.n_cat),
+                ("full", np.arange(space.n_surrogate_features),
+                 space.n_cont_features, space.n_cat)):
+            st = gp_mod.fit_auto(feats[:, idx], qor, n_cont=ncont,
+                                 n_cat=ncat)
+            mu, _ = gp_mod.predict(st, test_f[:, idx], n_cont=ncont,
+                                   n_cat=ncat)
+            rhos[name] = spearman(np.asarray(mu), test_q)
+        assert rhos["screened"] > rhos["full"] - 1e-9, rhos
+        assert rhos["screened"] > 0.5, rhos

@@ -273,7 +273,12 @@ class TestSurrogateActivityGuards:
         m = self._mgr(self._cat_space(200))
         assert m.min_model_points == 16
 
-    def test_budget_rule_sets_passive_and_warns(self):
+    def test_budget_rule_selects_bandit_recipe(self):
+        """r4 verdict #4: when the eval budget is below the parameter
+        count and the plane CAN be bandit-arbitrated, the driver now
+        applies the measured-best budget-constrained recipe (bandit
+        arbitration, affordable non-parity pulls) instead of
+        passivating."""
         import warnings
 
         sp = self._cat_space(40)
@@ -287,7 +292,31 @@ class TestSurrogateActivityGuards:
             warnings.simplefilter("always")
             t.run(test_limit=20)    # 20 < 40 scalar params
         t.close()
+        assert not t.surrogate.passive
+        assert t._surr_arm
+        assert t.surrogate.arbitration == "bandit"
+        assert t.surrogate.propose_batch == 8       # parity off
+        assert any("BUDGET-CONSTRAINED" in str(x.message) for x in w)
+
+    def test_budget_rule_passivates_without_plane(self):
+        """With the proposal plane disabled (propose_batch=0) the
+        budget-constrained recipe cannot engage; the rule falls back to
+        passivation, the measured-safe default."""
+        import warnings
+
+        sp = self._cat_space(40)
+
+        def obj(cfgs):
+            return [1.0 for _ in cfgs]
+
+        t = Tuner(sp, obj, seed=0, surrogate="gp",
+                  surrogate_opts={"min_points": 16, "propose_batch": 0})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t.run(test_limit=20)    # 20 < 40 scalar params
+        t.close()
         assert t.surrogate.passive
+        assert not t._surr_arm
         assert any("PASSIVE" in str(x.message) for x in w)
 
     def test_budget_rule_respects_opt_out_and_big_budgets(self):

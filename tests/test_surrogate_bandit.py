@@ -117,21 +117,43 @@ class TestDriverWiring:
         t._apply_budget_rule(test_limit=5)      # 5 << 32 params
         assert not t.surrogate.passive          # auto_passive off
 
-    def test_budget_rule_orthogonal_to_arbitration(self):
-        """The run-budget passivation rule gates whether the plane is
-        ACTIVE in BOTH arbitration modes (a technique-batch-sized pool
-        pull is unaffordable on a tiny budget no matter who chooses
-        it); arbitration only decides when an active plane pulls."""
+    def test_budget_rule_applies_recipe_by_arbitration(self):
+        """r4 verdict #4: on a small budget the rule applies the
+        measured-best budget-constrained recipe.  An explicitly
+        bandit-arbitrated plane is left exactly as the user configured
+        it (including pull-size parity); a scheduled plane is switched
+        to bandit arbitration with parity off — and switched BACK on a
+        later large-budget run (the rule is per run)."""
         space = Space([FloatParam(f"x{i}", 0, 1) for i in range(32)])
-        for arb in ("bandit", "schedule"):
-            t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
-                      surrogate="gp",
-                      surrogate_opts=_opts(arbitration=arb,
-                                           auto_passive=True))
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore")
-                t._apply_budget_rule(test_limit=5)  # 5 << 32 params
-            assert t.surrogate.passive, arb
+        # explicit bandit arbitration: untouched
+        t = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                  surrogate="gp",
+                  surrogate_opts=_opts(arbitration="bandit",
+                                       auto_passive=True))
+        raised = t.surrogate.propose_batch      # parity raised at init
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t._apply_budget_rule(test_limit=5)  # 5 << 32 params
+        assert not t.surrogate.passive
+        assert t._surr_arm
+        assert t.surrogate.propose_batch == raised
+        # scheduled plane: switched to the recipe, then reverted
+        t2 = Tuner(space, lambda cfgs: [0.0] * len(cfgs), seed=0,
+                   surrogate="gp",
+                   surrogate_opts=_opts(arbitration="schedule",
+                                        auto_passive=True))
+        assert not t2._surr_arm
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            t2._apply_budget_rule(test_limit=5)
+        assert not t2.surrogate.passive
+        assert t2._surr_arm
+        assert t2.surrogate.arbitration == "bandit"
+        assert t2.surrogate.propose_batch == 8      # parity off
+        assert any("BUDGET-CONSTRAINED" in str(x.message) for x in w)
+        t2._apply_budget_rule(test_limit=4000)      # per-run revert
+        assert t2.surrogate.arbitration == "schedule"
+        assert not t2._surr_arm
 
     def test_pull_size_parity(self):
         """Under bandit arbitration the pool batch is raised to the

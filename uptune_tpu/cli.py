@@ -64,6 +64,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "+ affordable 8-eval pulls, no passivation — "
                         "0.88x baseline on gcc-real at 30 seeds, "
                         "BENCHREPORT.md)")
+    p.add_argument("--surrogate-screen", action="append", default=None,
+                   metavar="ARCHIVE",
+                   help="cross-payload transfer: driver jsonl trial "
+                        "archive(s) from OTHER workloads over the SAME "
+                        "space (repeatable).  The surrogate restricts "
+                        "its model to the feature lanes that measurably "
+                        "moved QoR there and biases its pool mutations "
+                        "toward them (surrogate/screen.py) — the "
+                        "measured fix for budget<params runs where an "
+                        "unscreened GP stays prior-dominated")
+    p.add_argument("--surrogate-screen-top", default="16,24",
+                   metavar="CONT,CAT",
+                   help="screen sizes: continuous lanes, categorical "
+                        "groups kept (default 16,24)")
     p.add_argument("--seed", type=int, default=None, help="RNG seed")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
@@ -316,6 +330,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         sopts = {"arbitration": args.surrogate_arbitration}
     else:
         sopts = None
+    if args.surrogate_screen:
+        try:
+            c, k = (int(x) for x in args.surrogate_screen_top.split(","))
+        except ValueError:
+            print("ut: --surrogate-screen-top must be 'CONT,CAT' "
+                  "integers", file=sys.stderr)
+            return 2
+        sopts = dict(sopts or {})
+        sopts["screen"] = {"archives": list(args.surrogate_screen),
+                           "top_cont": c, "top_cat": k}
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,
         parallel=args.parallel_factor, test_limit=args.test_limit,

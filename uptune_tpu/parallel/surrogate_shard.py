@@ -11,7 +11,10 @@ the [B/n] score slice on ICI, no psum in the hot path.
 Single-chip companions: `surrogate/gp.py` (plain XLA, B up to ~10^5)
 and `surrogate/pallas_score.py` (fused Pallas kernel for the
 million-candidate regime).  This module spreads either regime across
-the mesh.
+the mesh — and picks between them PER SHARD: once a device's slice
+reaches PALLAS_MIN_POOL candidates, mean/ei/lcb route through the
+fused mean+variance kernel instead of gp.predict (override with
+`use_pallas=`).
 
 The reference has no analogue — its XGBoost surrogate scores candidate
 dicts one batch per process (`/root/reference/python/uptune/
@@ -27,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..surrogate import gp as gp_mod
+from ..surrogate import pallas_score
 from ..surrogate.gp import GPState
 from .sharded import shard_map
 
@@ -39,7 +43,8 @@ def sharded_gp_score(mesh, axis: str, state: GPState, feats: jax.Array,
                      key: Optional[jax.Array] = None,
                      beta: float = 2.0,
                      n_cont: Optional[int] = None,
-                     n_cat: int = 0) -> jax.Array:
+                     n_cat: int = 0,
+                     use_pallas: Optional[bool] = None) -> jax.Array:
     """[B, F] candidate features -> [B] acquisition scores, with B
     sharded over `mesh.shape[axis]` devices and the GPState replicated.
 
@@ -71,17 +76,26 @@ def sharded_gp_score(mesh, axis: str, state: GPState, feats: jax.Array,
     best_arr = jnp.asarray(0.0 if best_y is None else best_y,
                            jnp.float32)
     key_arr = jax.random.PRNGKey(0) if key is None else key
+    # per-shard regime choice (static: shard size is b // n at trace
+    # time): large slices use the fused Pallas mean+variance kernel,
+    # small ones keep plain XLA; thompson always uses gp.predict (its
+    # draw needs the same moments, but stays off the fused path so the
+    # per-shard key folding below remains the only RNG difference)
+    if use_pallas is None:
+        use_pallas = (b // n) >= pallas_score.PALLAS_MIN_POOL
 
     def local(state, best_arr, key_arr, shard):
+        if use_pallas and kind in ("mean", "ei", "lcb"):
+            mu, sd = pallas_score.gp_mean_var_scores(
+                state, shard, n_cont=n_cont, n_cat=n_cat)
+        elif kind != "thompson":
+            mu, sd = gp_mod.predict(state, shard, n_cont, n_cat)
         if kind == "mean":
-            mu, _ = gp_mod.predict(state, shard, n_cont, n_cat)
             return mu
         if kind == "ei":
-            return gp_mod.expected_improvement(state, shard, best_arr,
-                                               n_cont, n_cat)
+            return gp_mod.ei_from_moments(mu, sd, best_arr)
         if kind == "lcb":
-            return gp_mod.lower_confidence_bound(state, shard, beta,
-                                                 n_cont, n_cat)
+            return mu - beta * sd
         k = jax.random.fold_in(key_arr, jax.lax.axis_index(axis))
         return gp_mod.thompson(state, shard, k, n_cont, n_cat)
 

@@ -34,6 +34,12 @@ class GPState(NamedTuple):
     # import — which breaks jax.distributed.initialize() in every
     # multi-process run (and hangs outright on a wedged axon tunnel).
     ls_cat: float = 1.0
+    # optional premasked K^-1 for the fused Pallas variance path
+    # (pallas_score.gp_mean_var_scores).  None by default: it costs an
+    # extra O(N^3) solve, so only callers that will score large pools
+    # attach it — once per (re)fit via precompute_kinv(), not once per
+    # scoring call (r5 review).
+    kinv: Optional[jax.Array] = None
 
 
 def _raw_d2(x1: jax.Array, x2: jax.Array) -> jax.Array:
@@ -253,6 +259,20 @@ def fit_auto(x: jax.Array, y: jax.Array,
         best = sweep(g2)
     return fit(x, y, best[0], best[1], mask,
                n_cont=n_cont, n_cat=n_cat, ls_cat=best[2])
+
+
+def precompute_kinv(state: GPState) -> GPState:
+    """Attach the premasked K^-1 the fused Pallas variance path needs
+    (pallas_score module docstring: the mask-adjusted K is
+    block-diagonal, so zeroing padded rows/cols of its inverse makes
+    the tile-level quadratic form equal the unpadded solve exactly).
+    Call once per (re)fit when large-pool scoring is expected; the
+    Pallas path falls back to computing it per call otherwise."""
+    n = state.x.shape[0]
+    kinv = jax.scipy.linalg.cho_solve(
+        (jnp.asarray(state.chol, jnp.float32), True), jnp.eye(n))
+    kinv = kinv * state.mask[:, None] * state.mask[None, :]
+    return state._replace(kinv=kinv)
 
 
 def predict(state: GPState, xq: jax.Array,

@@ -23,6 +23,7 @@ import numpy as np
 from ..space.spec import CandBatch, Space
 from . import gp as gp_mod
 from . import mlp as mlp_mod
+from . import pallas_score
 
 KINDS = ("gp", "mlp")
 
@@ -44,7 +45,8 @@ class SurrogateManager:
                  min_model_points: Optional[int] = None,
                  auto_passive: bool = True,
                  arbitration: str = "schedule",
-                 propose_batch_parity: bool = True):
+                 propose_batch_parity: bool = True,
+                 screen=None):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         if arbitration not in ("schedule", "bandit"):
@@ -115,9 +117,43 @@ class SurrogateManager:
         # surrogate feature representation (Space.surrogate_transform):
         # numeric lanes snapped to their decoded grid, categorical lanes
         # one-hot — static split point for the GP's mixed
-        # Matérn×exponential-Hamming kernel (VERDICT r3 next-step #2)
-        self._n_cont = space.n_cont_features
-        self._n_cat = space.n_cat
+        # Matérn×exponential-Hamming kernel (VERDICT r3 next-step #2).
+        # An optional FeatureScreen (surrogate/screen.py) restricts the
+        # MODEL's view to the lanes that measurably moved QoR on other
+        # payloads of the same space (cross-payload transfer, r4 verdict
+        # next-step #3): every transform below is followed by the
+        # projection, and the kernel split becomes the screened one.
+        # The search techniques still propose in the FULL space — only
+        # the surrogate narrows.  A dict form defers construction to
+        # here, where the space exists: {"archives": [paths],
+        # "top_cont": int, "top_cat": int} (the CLI's
+        # --surrogate-screen flag arrives this way).
+        if isinstance(screen, dict):
+            from .screen import screen_from_archives
+            paths = list(screen.get("archives", ()))
+            screen = screen_from_archives(
+                space, paths,
+                top_cont=screen.get("top_cont", 16),
+                top_cat=screen.get("top_cat", 24))
+            if screen is None and paths:
+                # a requested screen must never degrade silently: the
+                # user would attribute the run's numbers to a transfer
+                # that never engaged (r5 review)
+                import warnings
+                warnings.warn(
+                    f"--surrogate-screen: none of {len(paths)} "
+                    f"archive(s) contributed rows (missing, empty, or "
+                    f"<4 usable trials) — running UNSCREENED",
+                    UserWarning)
+        self.screen = screen
+        if screen is not None:
+            self._n_cont = int(screen.n_cont)
+            self._n_cat = int(screen.n_cat)
+            self._screen_idx = jnp.asarray(screen.idx, jnp.int32)
+        else:
+            self._n_cont = space.n_cont_features
+            self._n_cat = space.n_cat
+            self._screen_idx = None
 
         # Two activity guards, both measured (BENCHREPORT "Why the
         # surrogate does not beat the bandit on gcc-real"):
@@ -159,6 +195,15 @@ class SurrogateManager:
             self._score = jax.jit(mlp_mod.predict_members)
 
     # ------------------------------------------------------------------
+    def _sx(self, feats):
+        """Space features -> surrogate representation, screened when a
+        FeatureScreen is installed (the single chokepoint: observe, the
+        prune mask, and the proposal pool must all see the same view)."""
+        sf = self.space.surrogate_transform(feats)
+        if self._screen_idx is not None:
+            sf = sf[..., self._screen_idx]
+        return sf
+
     @property
     def n_points(self) -> int:
         return len(self._ys)
@@ -172,8 +217,7 @@ class SurrogateManager:
         `feats` is the Space.features() representation (what the driver
         hands over); it is re-encoded to the surrogate representation
         (snapped numeric lanes + one-hot categoricals) on the way in."""
-        sf = np.asarray(self.space.surrogate_transform(
-            jnp.asarray(feats, jnp.float32)))
+        sf = np.asarray(self._sx(jnp.asarray(feats, jnp.float32)))
         for f, q in zip(sf, np.asarray(qor)):
             self._xs.append(np.asarray(f, np.float32))
             self._ys.append(float(q))
@@ -203,6 +247,12 @@ class SurrogateManager:
         y = jnp.concatenate([y, jnp.zeros(bucket - n, y.dtype)])
         if self.kind == "gp":
             self._state = self._fit(x, y, mask)
+            if (self.propose_batch * self.pool_mult
+                    >= pallas_score.PALLAS_MIN_POOL):
+                # large pools score through the fused Pallas variance
+                # path; attach the premasked K^-1 ONCE per refit rather
+                # than once per pool pull (r5 review)
+                self._state = gp_mod.precompute_kinv(self._state)
         else:
             self._state = self._fit(kf, x, y, mask)
         finite = [v for v in self._ys if np.isfinite(v)]
@@ -225,7 +275,7 @@ class SurrogateManager:
             return None
         if self.passive or self.n_points < self.min_model_points:
             return None     # guards: see __init__
-        feats = self.space.surrogate_transform(self.space.features(cands))
+        feats = self._sx(self.space.features(cands))
         preds = None
         use_ei = (self.select == "topk" and self.score_kind == "ei"
                   and self._best_y is not None)
@@ -306,9 +356,28 @@ class SurrogateManager:
             jnp.asarray(space.cat_lane_idx, jnp.int32)].set(1.0) \
             if space.n_cat else jnp.zeros(space.n_scalar)
         max_flips = max(2, space.n_cat // 8)
+        # per-lane flip probability: uniform over categorical lanes by
+        # default; with a FeatureScreen installed, 75% of the flip mass
+        # follows the transferred per-flag sensitivity (flags that moved
+        # QoR on the source payloads get proportionally more mutation)
+        # and 25% stays uniform so unscreened flags remain reachable
+        u_norm = cat_row / max(space.n_cat, 1)
+        if self.screen is not None and space.n_cat:
+            w = jnp.asarray(self.screen.cat_weight, jnp.float32)
+            wsum = float(np.asarray(self.screen.cat_weight).sum())
+            w_norm = (w / wsum) if wsum > 0 else u_norm
+            flip_p = 0.75 * w_norm + 0.25 * u_norm
+        else:
+            flip_p = u_norm
         kind = self.kind
         score_ei = self.score_kind == "ei"
         nc, ncat = self._n_cont, self._n_cat
+        sidx = self._screen_idx
+        # at PALLAS_MIN_POOL+ candidates the [pool, N] cross-kernel is
+        # the acquisition hot spot; the fused Pallas kernel scores it
+        # tile-by-tile without materializing it in HBM (r4 verdict
+        # next-step #2 — this is the live call site)
+        use_pallas = (kind == "gp" and pool >= pallas_score.PALLAS_MIN_POOL)
         from ..ops import perm as perm_ops
 
         def pool_fn(state, key, best_u, best_perms, best_y):
@@ -335,7 +404,7 @@ class SurrogateManager:
                     kf1, (n_flip, 1), minval=0.0,
                     maxval=float(np.log2(max_flips))))
                 sel = (jax.random.uniform(kf2, (n_flip, space.n_scalar))
-                       < nf / max(space.n_cat, 1)) & (cat_row > 0)
+                       < nf * flip_p[None, :]) & (cat_row > 0)
                 vals = space.decode_scalars(best_u)          # [D] codes
                 ncodes = space.vhi + 1.0
                 off = 1.0 + jnp.floor(
@@ -371,8 +440,17 @@ class SurrogateManager:
             local = CandBatch(u_loc, tuple(perms_loc))
             cands = space.normalize(rand.concat(local))
             feats = space.surrogate_transform(space.features(cands))
+            if sidx is not None:
+                feats = feats[..., sidx]
             if kind == "gp":
-                if score_ei:
+                if use_pallas:
+                    mu, sd = pallas_score.gp_mean_var_scores(
+                        state, feats, n_cont=nc, n_cat=ncat)
+                    if score_ei:
+                        score = -gp_mod.ei_from_moments(mu, sd, best_y)
+                    else:
+                        score = mu - 2.0 * sd
+                elif score_ei:
                     score = -gp_mod.expected_improvement(
                         state, feats, best_y, n_cont=nc, n_cat=ncat)
                 else:
