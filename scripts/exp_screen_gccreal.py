@@ -69,7 +69,7 @@ def gen_archives(payloads) -> None:
 
 
 def run_screened(target: str, seeds: int, top: str, state_path: str,
-                 flip_only: bool = False) -> None:
+                 flip_only: bool = False, soft: bool = False) -> None:
     from uptune_tpu.surrogate.screen import screen_from_archives
 
     top_cont, top_cat = (int(x) for x in top.split(","))
@@ -84,7 +84,8 @@ def run_screened(target: str, seeds: int, top: str, state_path: str,
               file=sys.stderr)
         sys.exit(1)
     n_src = sum(1 for p in paths if os.path.exists(p))
-    arm = f"screen-{top}" + ("-fliponly" if flip_only else "")
+    arm = f"screen-{top}" + ("-fliponly" if flip_only else "") \
+        + ("-soft" if soft else "")
     print(f"screen for {target}: {n_src} source archives from "
           f"{others}, kept {sc.n_cont} cont lanes + {sc.n_cat} groups "
           f"({len(sc.idx)} of {space.n_surrogate_features} lanes)",
@@ -110,9 +111,11 @@ def run_screened(target: str, seeds: int, top: str, state_path: str,
             if key in done:
                 rows.append(done[key])
                 continue
+            sopts = {"propose_batch_parity": False, "screen": sc}
+            if soft:
+                sopts["screen_mode"] = "soft"
             r = one_run(prob, "surrogate-bandit", seed=seed, budget=80,
-                        sopts_override={"propose_batch_parity": False,
-                                        "screen": sc})
+                        sopts_override=sopts)
             r.update({"target": target, "arm": arm, "seed": seed})
             rows.append(r)
             out.write(json.dumps(r) + "\n")
@@ -141,13 +144,16 @@ def main():
     ap.add_argument("--top", default="16,24")
     ap.add_argument("--flip-only", action="store_true",
                     help="ablation: full-width GP, screened flip bias")
+    ap.add_argument("--soft", action="store_true",
+                    help="soft ARD mode: full width, per-lane "
+                         "sensitivity scaling instead of restriction")
     ap.add_argument("--state", default="exp_screen_gccreal.jsonl")
     args = ap.parse_args()
     if args.phase == "archives":
         gen_archives([p for p in args.payloads.split(",") if p])
     else:
         run_screened(args.target, args.seeds, args.top, args.state,
-                     flip_only=args.flip_only)
+                     flip_only=args.flip_only, soft=args.soft)
 
 
 if __name__ == "__main__":

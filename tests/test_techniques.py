@@ -339,3 +339,12 @@ def test_legacy_two_arg_credit_meta_still_works():
     with pytest.raises(TypeError, match="bug inside"):
         t2.run(test_limit=60)
     t2.close()
+
+
+def test_experimental_label():
+    """AUCBanditMetaTechniqueTPU measured 1.62x behind portfolio A at 30
+    matched seeds (AB_PORTFOLIO.md); it stays registered but must carry
+    the [experimental] tag the CLI listing surfaces (r4 verdict #6)."""
+    from uptune_tpu.techniques.base import is_experimental
+    assert is_experimental("AUCBanditMetaTechniqueTPU")
+    assert not is_experimental("AUCBanditMetaTechniqueA")

@@ -77,7 +77,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--surrogate-screen-top", default="16,24",
                    metavar="CONT,CAT",
                    help="screen sizes: continuous lanes, categorical "
-                        "groups kept (default 16,24)")
+                        "groups kept (default 16,24; hard mode only)")
+    p.add_argument("--surrogate-screen-mode", default="hard",
+                   choices=("hard", "soft"),
+                   help="'hard' restricts the model to the top-k lanes; "
+                        "'soft' keeps full width and scales each lane "
+                        "by its transferred sensitivity (per-lane ARD)")
+    p.add_argument("--seed-configuration", action="append", default=None,
+                   metavar="JSON",
+                   help="JSON file with a known-good configuration (or "
+                        "a list of them) injected as 'seed' trials at "
+                        "startup, evaluated before any technique batch "
+                        "— warm-starts expensive runs from prior bests "
+                        "(repeatable; partial configs are merged over "
+                        "the declared defaults).  The reference's "
+                        "--seed-configuration flag")
     p.add_argument("--seed", type=int, default=None, help="RNG seed")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
@@ -265,9 +279,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         force_cpu(1)
 
     if args.list_techniques:
-        from .techniques.base import all_technique_names
+        from .techniques.base import all_technique_names, is_experimental
         for name in all_technique_names():
-            print(name)
+            # [experimental] = measured BEHIND the default portfolio on
+            # the reference fixtures (AB_PORTFOLIO.md) — selectable,
+            # not recommended
+            print(f"{name}  [experimental]" if is_experimental(name)
+                  else name)
         return 0
     if not args.script:
         print("ut: a script to tune is required", file=sys.stderr)
@@ -340,13 +358,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         sopts = dict(sopts or {})
         sopts["screen"] = {"archives": list(args.surrogate_screen),
                            "top_cont": c, "top_cat": k}
+        sopts["screen_mode"] = args.surrogate_screen_mode
+    seed_cfgs = []
+    for path in (args.seed_configuration or []):
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"ut: --seed-configuration {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        if isinstance(loaded, dict):
+            loaded = [loaded]
+        if not (isinstance(loaded, list)
+                and all(isinstance(c, dict) for c in loaded)):
+            print(f"ut: --seed-configuration {path}: expected a JSON "
+                  f"object or list of objects", file=sys.stderr)
+            return 2
+        seed_cfgs.extend(loaded)
+
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,
         parallel=args.parallel_factor, test_limit=args.test_limit,
         runtime_limit=args.runtime_limit, timeout=args.timeout,
         technique=technique, seed=args.seed, params_file=args.params,
         resume=args.resume, sandbox=not args.no_sandbox,
-        surrogate=surrogate, surrogate_opts=sopts, template=template)
+        surrogate=surrogate, surrogate_opts=sopts, template=template,
+        seed_configs=seed_cfgs)
 
     if args.cfg:
         for k in sorted(settings):

@@ -145,13 +145,18 @@ class Tuner:
                  config_filter: Optional[
                      Callable[[Dict[str, Any]], bool]] = None,
                  hooks: Optional[Sequence] = None,
-                 label: str = ""):
+                 label: str = "",
+                 input_manager=None):
         assert sense in ("min", "max"), sense
         # identifies this tuner in shared-hook output (multi-stage runs
         # pass one hook list to several tuners; events interleave)
         self.label = label
         self.space = space
         self.objective = objective
+        # input-selection policy (driver/inputs.py, the reference's
+        # measurement InputManager seam): when set, step() calls the
+        # objective as objective(cfgs, inputs) with before/after hooks
+        self.input_manager = input_manager
         # search-space restriction predicate (ut.rule); rejected configs
         # are never evaluated/archived and serve +inf to their technique
         self.config_filter = config_filter
@@ -849,7 +854,18 @@ class Tuner:
             return self._finalize(tk)
         cfgs = [tr.config for tr in tk.trials]
         t0 = time.time()
-        vals = np.asarray(self.objective(cfgs), np.float64).reshape(-1)
+        im = self.input_manager
+        if im is not None:
+            inps = [im.select_input(tr) for tr in tk.trials]
+            for tr, i in zip(tk.trials, inps):
+                im.before_run(tr, i)
+            vals = np.asarray(self.objective(cfgs, inps),
+                              np.float64).reshape(-1)
+            for tr, i in zip(tk.trials, inps):
+                im.after_run(tr, i)
+        else:
+            vals = np.asarray(self.objective(cfgs),
+                              np.float64).reshape(-1)
         dur = (time.time() - t0) / max(1, len(cfgs))
         stats = None
         for tr, v in zip(tk.trials, vals):

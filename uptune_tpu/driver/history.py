@@ -8,18 +8,32 @@ history is a pair of sorted uint32 hash arrays living on device, and both
 membership and known-QoR lookup are a single vectorized `searchsorted` +
 windowed compare over the whole candidate batch.
 
-Insertion is a merge: concatenate, lexicographic `lax.sort` on the two hash
-words, truncate to capacity.  Empty slots hold the (0xFFFFFFFF, 0xFFFFFFFF)
-sentinel so they sort to the end; real h0 values are clamped to
-0xFFFFFFFE.  All functions are pure and jittable with static shapes.
+Insertion is a TRUE MERGE (r5, the acquisition-loop hot spot on both
+the 1-core fallback and the TPU scale ladder): the history is already
+h0-sorted, so only the incoming batch is sorted (B rows, cheap) and the
+two runs are interleaved with two `searchsorted`s + one scatter —
+O(cap) data movement instead of the previous two full-width
+multi-operand `lax.sort`s over cap+B rows.  Empty slots hold the
+(0xFFFFFFFF, 0xFFFFFFFF) sentinel so they land at the end; real h0
+values are clamped to 0xFFFFFFFE.  All functions are pure and jittable
+with static shapes.
+
+Invariant: h0 ascending with equal-h0 runs CONTIGUOUS; h1 is NOT
+ordered within a run (contains() scans the short run window and never
+needed it — h0 collisions of distinct configs are ~n^2/2^33).
 
 Past capacity, eviction is OLDEST-FIRST (each row carries the insert-step
 it arrived in; overflow drops the smallest ages), not largest-hash: recent
 entries are the ones proposals collide with, so dedup degrades
 predictably on long runs (VERDICT r2 weak #5 — the old truncate-by-hash
-dropped arbitrary configs).  Evicted-live-row counts accumulate in
+dropped arbitrary configs).  Eviction runs under `lax.cond`, so
+non-overflowing steps skip it entirely; ties at the threshold age drop
+in hash order (deterministic — the old single-key unstable sort left
+the tie order unspecified).  Evicted-live-row counts accumulate in
 `HistState.dropped` so the driver can surface degradation instead of
-warning once and going silent.
+warning once and going silent.  A batch with more valid rows than the
+whole capacity is out of contract (the excess drops from the merge
+tail in hash order).
 """
 from __future__ import annotations
 
@@ -94,34 +108,81 @@ class History:
     def insert(self, st: HistState, hashes: jax.Array, qor: jax.Array,
                valid: jax.Array) -> HistState:
         """Merge a batch of (hash, qor) rows where `valid` is True.
-        Overflow beyond capacity evicts the OLDEST live rows first
-        (empty slots before any live row); the count of evicted live
-        rows accumulates in `dropped`."""
+        Overflow beyond capacity evicts the OLDEST live rows first; the
+        count of evicted live rows accumulates in `dropped`.
+
+        Pipeline (module docstring): [cond] evict-and-compact the
+        history in place, sort ONLY the B-row batch, then stable-merge
+        the two h0-sorted runs by scatter.  No full-width sort."""
+        cap = self.capacity
+        b = hashes.shape[0]
         h0n, h1n = self._clamp(hashes)
         h0n = jnp.where(valid, h0n, jnp.uint32(_SENTINEL))
         h1n = jnp.where(valid, h1n, jnp.uint32(_SENTINEL))
         age_n = jnp.where(valid, st.step, -1).astype(jnp.int32)
-        h0c = jnp.concatenate([st.h0, h0n])
-        h1c = jnp.concatenate([st.h1, h1n])
-        qc = jnp.concatenate([st.qor, qor.astype(jnp.float32)])
-        ac = jnp.concatenate([st.age, age_n])
-        cap = self.capacity
-        # phase 1: order by recency — live rows (age >= 0) newest-first,
-        # then empty/invalid slots (age == -1 -> key +1, after all live
-        # keys which are <= 0) — and keep the first `cap`
-        key = jnp.where(ac >= 0, -ac, 1)
-        _, h0k, h1k, qk, ak = jax.lax.sort(
-            (key, h0c, h1c, qc, ac), num_keys=1)
-        h0k, h1k, qk, ak = h0k[:cap], h1k[:cap], qk[:cap], ak[:cap]
-        # evicted rows must not survive as hash-matchable ghosts
-        h0k = jnp.where(ak >= 0, h0k, jnp.uint32(_SENTINEL))
-        h1k = jnp.where(ak >= 0, h1k, jnp.uint32(_SENTINEL))
-        # phase 2: restore the sorted-hash invariant contains() needs
-        h0s, h1s, qs, ags = jax.lax.sort((h0k, h1k, qk, ak), num_keys=2)
-        total = st.n + valid.sum().astype(jnp.int32)
-        n = jnp.minimum(total, cap)
+        qn = jnp.where(valid, qor.astype(jnp.float32), jnp.inf)
+
+        n_new = valid.sum().astype(jnp.int32)
+        total = st.n + n_new
         overflow = jnp.maximum(total - cap, 0)
-        return HistState(h0s, h1s, qs, n, ags, st.step + 1,
+
+        def evict(args):
+            h0, h1, q, age, k = args
+            live = age >= 0
+            big = jnp.asarray(0x7FFFFFFF, jnp.int32)
+            ages_live = jnp.where(live, age, big)
+            # k-th smallest live age = eviction threshold; rows strictly
+            # older all drop, ties at the threshold drop in hash order
+            thr = jnp.sort(ages_live)[jnp.clip(k - 1, 0, cap - 1)]
+            drop_lt = live & (age < thr)
+            eq = live & (age == thr)
+            m = k - drop_lt.sum().astype(jnp.int32)
+            drop_eq = eq & (jnp.cumsum(eq.astype(jnp.int32)) <= m)
+            keep = live & ~(drop_lt | drop_eq)
+            # compact the kept rows to the front (stays h0-sorted)
+            dest = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            dest = jnp.where(keep, dest, cap)     # drop out-of-bounds
+            h0c = jnp.full((cap,), _SENTINEL, jnp.uint32) \
+                .at[dest].set(h0, mode="drop")
+            h1c = jnp.full((cap,), _SENTINEL, jnp.uint32) \
+                .at[dest].set(h1, mode="drop")
+            qc = jnp.full((cap,), jnp.inf, jnp.float32) \
+                .at[dest].set(q, mode="drop")
+            ac = jnp.full((cap,), -1, jnp.int32) \
+                .at[dest].set(age, mode="drop")
+            return h0c, h1c, qc, ac
+
+        h0h, h1h, qh, ah = jax.lax.cond(
+            overflow > 0, evict, lambda a: a[:4],
+            (st.h0, st.h1, st.qor, st.age, overflow))
+
+        # sort the batch by h0 (B rows — the only sort in the pipeline)
+        h0s, order = jax.lax.sort(
+            (h0n, jnp.arange(b, dtype=jnp.int32)), num_keys=1)
+        h1s, qs, ags = h1n[order], qn[order], age_n[order]
+
+        # stable two-run merge: old rows before new rows on equal h0
+        # (keeps equal-h0 runs contiguous; h1 order within a run is
+        # unspecified by the invariant)
+        pos_hist = (jnp.arange(cap, dtype=jnp.int32)
+                    + jnp.searchsorted(h0s, h0h, side="left"
+                                       ).astype(jnp.int32))
+        pos_new = (jnp.arange(b, dtype=jnp.int32)
+                   + jnp.searchsorted(h0h, h0s, side="right"
+                                      ).astype(jnp.int32))
+
+        def scat(hist_v, new_v, fill, dtype):
+            out = jnp.full((cap + b,), fill, dtype)
+            out = out.at[pos_hist].set(hist_v, mode="drop")
+            return out.at[pos_new].set(new_v, mode="drop")
+
+        h0m = scat(h0h, h0s, _SENTINEL, jnp.uint32)[:cap]
+        h1m = scat(h1h, h1s, _SENTINEL, jnp.uint32)[:cap]
+        qm = scat(qh, qs, jnp.inf, jnp.float32)[:cap]
+        am = scat(ah, ags, -1, jnp.int32)[:cap]
+
+        n = jnp.minimum(total, cap)
+        return HistState(h0m, h1m, qm, n, am, st.step + 1,
                          st.dropped + overflow)
 
 

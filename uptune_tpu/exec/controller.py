@@ -61,7 +61,13 @@ class ProgramTuner:
                  env: Optional[Dict[str, str]] = None,
                  sandbox: bool = True,
                  status_interval: Optional[int] = None,
-                 template=None, hooks=None):
+                 template=None, hooks=None,
+                 seed_configs: Optional[List[Dict]] = None):
+        # seed_configs: known-good configurations injected as 'seed'
+        # trials at startup (the reference's --seed-configuration file
+        # loading, opentuner/search/driver.py:37-42) — warm-starts
+        # expensive runs from prior bests.  Unlike the declared-defaults
+        # seed their QoR is unknown, so they are EVALUATED first.
         # template: a TemplateProgram (non-intrusive mode) — the space
         # comes from its annotations and each trial renders its own copy
         # of the source into the sandbox before launch
@@ -107,6 +113,7 @@ class ProgramTuner:
         self.archive = archive if archive is not None else os.path.join(
             self.work_dir, f"ut.archive{self.host_tag}.jsonl")
         self.resume = resume
+        self.seed_configs = list(seed_configs or [])
         if surrogate is None:
             # same flags > ut.config() > defaults layering as the
             # sibling parameters above; the settings key holds a kind
@@ -292,6 +299,22 @@ class ProgramTuner:
                 self._maybe_new_best(tuner.tell(tr, dq))
         else:
             queue.extend(seed_trials)
+        # user-provided seed configurations (--seed-configuration):
+        # merged over the declared defaults (a partial file is valid,
+        # like the reference's manipulator load), injected as 'seed'
+        # trials and evaluated ahead of any technique batch
+        if self.seed_configs:
+            defaults = default_config(records)
+            merged = []
+            for cfg in self.seed_configs:
+                unknown = sorted(set(cfg) - set(defaults))
+                if unknown:
+                    log.warning("[ut] seed configuration: ignoring "
+                                "unknown parameter(s) %s", unknown)
+                merged.append({**defaults,
+                               **{k: v for k, v in cfg.items()
+                                  if k in defaults}})
+            queue.extend(tuner.inject(merged, "seed"))
         queue.extend(self._host_proposals(space))
 
         pre_launch = None

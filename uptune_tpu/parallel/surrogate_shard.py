@@ -83,6 +83,11 @@ def sharded_gp_score(mesh, axis: str, state: GPState, feats: jax.Array,
     # per-shard key folding below remains the only RNG difference)
     if use_pallas is None:
         use_pallas = (b // n) >= pallas_score.PALLAS_MIN_POOL
+    if use_pallas and state.kinv is None and kind != "thompson":
+        # attach the premasked K^-1 ONCE here — inside the shard the
+        # fallback would re-run the O(N^3) solve per call on every
+        # device (r5 review)
+        state = gp_mod.precompute_kinv(state)
 
     def local(state, best_arr, key_arr, shard):
         if use_pallas and kind in ("mean", "ei", "lcb"):

@@ -27,6 +27,19 @@ from . import pallas_score
 
 KINDS = ("gp", "mlp")
 
+
+def _screen_feats(feats, sidx, sw):
+    """Apply a FeatureScreen's view to surrogate features: hard lane
+    selection (`sidx`), soft ARD scaling (`sw`), or neither.  The ONE
+    projection implementation — SurrogateManager._sx wraps it for host
+    paths and pool_fn captures it in its jit closure, so the model and
+    every query batch stay in the same representation by construction."""
+    if sidx is not None:
+        return feats[..., sidx]
+    if sw is not None:
+        return feats * sw
+    return feats
+
 # re-exported for callers that already import the manager; the source
 # of truth is jax-import-free (see uptune_tpu/calibrated.py)
 from ..calibrated import CALIBRATED_OPTS  # noqa: E402,F401
@@ -46,7 +59,7 @@ class SurrogateManager:
                  auto_passive: bool = True,
                  arbitration: str = "schedule",
                  propose_batch_parity: bool = True,
-                 screen=None):
+                 screen=None, screen_mode: str = "hard"):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         if arbitration not in ("schedule", "bandit"):
@@ -145,15 +158,26 @@ class SurrogateManager:
                     f"archive(s) contributed rows (missing, empty, or "
                     f"<4 usable trials) — running UNSCREENED",
                     UserWarning)
+        if screen_mode not in ("hard", "soft"):
+            raise ValueError(f"unknown screen_mode {screen_mode!r}; "
+                             f"known: hard, soft")
         self.screen = screen
+        self.screen_mode = screen_mode
+        self._screen_idx = None
+        self._screen_w = None
+        self._n_cont = space.n_cont_features
+        self._n_cat = space.n_cat
         if screen is not None:
-            self._n_cont = int(screen.n_cont)
-            self._n_cat = int(screen.n_cat)
-            self._screen_idx = jnp.asarray(screen.idx, jnp.int32)
-        else:
-            self._n_cont = space.n_cont_features
-            self._n_cat = space.n_cat
-            self._screen_idx = None
+            if screen_mode == "hard":
+                # hard restriction: the model sees only the top-k lanes
+                self._n_cont = int(screen.n_cont)
+                self._n_cat = int(screen.n_cat)
+                self._screen_idx = jnp.asarray(screen.idx, jnp.int32)
+            else:
+                # soft ARD: full width, per-lane sensitivity scaling —
+                # dead lanes' distances shrink instead of being cut
+                self._screen_w = jnp.asarray(screen.lane_weight,
+                                             jnp.float32)
 
         # Two activity guards, both measured (BENCHREPORT "Why the
         # surrogate does not beat the bandit on gcc-real"):
@@ -197,12 +221,10 @@ class SurrogateManager:
     # ------------------------------------------------------------------
     def _sx(self, feats):
         """Space features -> surrogate representation, screened when a
-        FeatureScreen is installed (the single chokepoint: observe, the
-        prune mask, and the proposal pool must all see the same view)."""
-        sf = self.space.surrogate_transform(feats)
-        if self._screen_idx is not None:
-            sf = sf[..., self._screen_idx]
-        return sf
+        FeatureScreen is installed (observe, the prune mask, and the
+        proposal pool all route through _screen_feats)."""
+        return _screen_feats(self.space.surrogate_transform(feats),
+                             self._screen_idx, self._screen_w)
 
     @property
     def n_points(self) -> int:
@@ -373,6 +395,7 @@ class SurrogateManager:
         score_ei = self.score_kind == "ei"
         nc, ncat = self._n_cont, self._n_cat
         sidx = self._screen_idx
+        sw = self._screen_w
         # at PALLAS_MIN_POOL+ candidates the [pool, N] cross-kernel is
         # the acquisition hot spot; the fused Pallas kernel scores it
         # tile-by-tile without materializing it in HBM (r4 verdict
@@ -439,9 +462,9 @@ class SurrogateManager:
                     jnp.where(coin, mut, shuf).astype(jnp.int32))
             local = CandBatch(u_loc, tuple(perms_loc))
             cands = space.normalize(rand.concat(local))
-            feats = space.surrogate_transform(space.features(cands))
-            if sidx is not None:
-                feats = feats[..., sidx]
+            feats = _screen_feats(
+                space.surrogate_transform(space.features(cands)),
+                sidx, sw)
             if kind == "gp":
                 if use_pallas:
                     mu, sd = pallas_score.gp_mean_var_scores(

@@ -52,12 +52,19 @@ class FeatureScreen(NamedTuple):
                  moved QoR on the source payloads.
     scores     : [n_full] per-lane sensitivity over the full rep
                  (introspection / ut-stats).
+    lane_weight: [n_full] float in [floor, 1] — the SOFT alternative to
+                 hard restriction: scaling the surrogate features by
+                 this vector is per-lane ARD (a high-sensitivity lane
+                 keeps its resolution, a dead lane's distances shrink
+                 toward zero instead of being cut).  Used when the
+                 manager runs with screen_mode='soft'.
     """
     idx: np.ndarray
     n_cont: int
     n_cat: int
     cat_weight: np.ndarray
     scores: np.ndarray
+    lane_weight: np.ndarray
 
     def apply(self, feats):
         """Project [B, n_full] surrogate features onto the kept lanes.
@@ -136,9 +143,21 @@ def build_screen(space, sources: Sequence[Tuple[np.ndarray, np.ndarray]],
         w = w / w.max() if w.max() > 0 else np.ones_like(w)
         cat_weight[np.asarray(space.cat_lane_idx)[grp_keep]] = w
 
+    # soft ARD weights over the FULL rep: normalize by a high quantile
+    # (not the max — one spiky lane must not flatten the rest), floor
+    # at 0.1 so no lane is invisible; group lanes share their group's
+    # sensitivity so a flag's one-hot columns scale together
+    ref = float(np.quantile(scores[scores > 0], 0.9)) \
+        if (scores > 0).any() else 1.0
+    lane_scores = scores.copy()
+    if ncat:
+        lane_scores[n_cont:] = np.repeat(gs, width)
+    lane_weight = np.clip(lane_scores / max(ref, 1e-12), 0.1, 1.0)
+
     return FeatureScreen(idx=idx, n_cont=int(len(cont_keep)),
                          n_cat=int(len(grp_keep)),
-                         cat_weight=cat_weight, scores=scores)
+                         cat_weight=cat_weight, scores=scores,
+                         lane_weight=lane_weight)
 
 
 def archive_rows(space, path: str):

@@ -318,7 +318,7 @@ def _register_portfolios():
     register(_portfolio("AUCBanditMetaTechniqueTPU", [
         de_alt(), ugm(sigma=0.1, mutation_rate=0.3,
                       name="NormalGreedyMutation"),
-        CMAES(), rnm()]))
+        CMAES(), rnm()]), experimental=True)
 
     # the generic restart-meta + plain round-robin, registered so
     # --technique can name them (metatechniques.py:78-180; VERDICT r2

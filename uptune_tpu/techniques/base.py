@@ -107,11 +107,25 @@ class Technique:
 _registry: Dict[str, Technique] = {}
 
 
-def register(t: Technique) -> Technique:
+_experimental: set = set()
+
+
+def register(t: Technique, experimental: bool = False) -> Technique:
+    """`experimental=True` flags a registered name as measured BEHIND
+    the defaults on the reference fixtures (surfaced as a suffix in
+    `ut --list-techniques`); it stays selectable via --technique but
+    its name alone must not suggest it is a recommended choice."""
     if t.name in _registry:
         raise ValueError(f"duplicate technique name {t.name!r}")
     _registry[t.name] = t
+    if experimental:
+        _experimental.add(t.name)
     return t
+
+
+def is_experimental(name: str) -> bool:
+    _ensure_loaded()
+    return name in _experimental
 
 
 def all_technique_names() -> List[str]:
