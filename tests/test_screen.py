@@ -184,3 +184,50 @@ class TestManagerIntegration:
             rhos[name] = spearman(np.asarray(mu), test_q)
         assert rhos["screened"] > rhos["full"] - 1e-9, rhos
         assert rhos["screened"] > 0.5, rhos
+
+
+class TestSoftScreen:
+    """screen_mode='soft': full-width per-lane ARD scaling from
+    transferred sensitivities (lane_weight), vs the hard top-k
+    restriction.  Measured on gcc-real in BENCHREPORT.md; these tests
+    pin the mechanics."""
+
+    def test_lane_weight_shape_and_bounds(self):
+        space = _space()
+        sc = build_screen(space, [_payload_data(space)], top_cont=2,
+                          top_cat=4)
+        w = sc.lane_weight
+        assert w.shape == (space.n_surrogate_features,)
+        assert (w >= 0.1 - 1e-9).all() and (w <= 1.0 + 1e-9).all()
+        # one-hot columns of the same flag share their group weight
+        nc, k = space.n_cont_features, space.cat_max_codes
+        gw = w[nc:].reshape(space.n_cat, k)
+        assert np.allclose(gw, gw[:, :1])
+
+    def test_soft_manager_full_width_scaled(self):
+        space = _space()
+        sc = build_screen(space, [_payload_data(space, seed=s)
+                                  for s in range(2)],
+                          top_cont=2, top_cat=4)
+        m = SurrogateManager(space, "gp", min_points=32,
+                             propose_batch=8, pool_mult=8, screen=sc,
+                             screen_mode="soft")
+        cands = space.random(jax.random.PRNGKey(5), 64)
+        _, qor = _payload_data(space, seed=5, n=64)
+        m.observe(np.asarray(space.features(cands)), qor)
+        assert m.maybe_refit()
+        # full width (no restriction), but the representation is scaled
+        assert m._state.x.shape[1] == space.n_surrogate_features
+        feats = np.asarray(m._sx(space.features(cands)))
+        raw = np.asarray(space.surrogate_transform(
+            space.features(cands)))
+        np.testing.assert_allclose(feats, raw * sc.lane_weight,
+                                   rtol=1e-6)
+        pool = m.propose_pool(jax.random.PRNGKey(6), cands.u[0], (),
+                              float(qor.min()))
+        assert pool is not None and pool.batch == 8
+
+    def test_bad_mode_rejected(self):
+        space = _space()
+        with pytest.raises(ValueError, match="screen_mode"):
+            SurrogateManager(space, "gp", screen_mode="fuzzy")
