@@ -606,6 +606,16 @@ background load; both were re-measured on an idle box and the jsonl
 rows replaced — seed 1002 improved 80-censored -> 47, the median is
 unchanged.)
 
+A third, transfer-free variant was also measured: `flip_bias='online'`
+(`--surrogate-flip-bias online`) re-ranks categorical groups by
+|Pearson r| over THE RUN'S OWN observations at each refit and biases
+only the plane's flip moves — no model narrowing, no foreign prior.
+At 10 matched seeds it is per-seed IDENTICAL to the unscreened
+bandit-arbitrated arm (median 18, 0/10 censored, exp_online_flip1.log):
+with ~16-80 observations the within-run correlation signal is too weak
+to move the 8-eval pulls off the unbiased trajectory.  Harmless, not
+helpful; default stays 'none'.
+
 The capability ships (it is the right tool when source and target
 workloads genuinely share structure — `--surrogate-screen`, hard and
 soft modes, both measured above), but the measured qsort rows keep it

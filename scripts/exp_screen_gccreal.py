@@ -3,24 +3,31 @@
 
 The r4 diagnosis: on gcc-real (80 evals, ~330 params -> ~1,100 one-hot
 lanes) the GP stays prior-dominated; the best measured arm (bandit
-arbitration, 8-eval pulls) reached 0.88x baseline.  The attack here is
-TRANSFER: mine per-flag sensitivity from full-budget archives of the
-OTHER payloads over the same mined space, restrict the surrogate to the
-top-k lanes (surrogate/screen.py), and bias the proposal plane's flip
-moves toward flags that measurably moved runtime elsewhere.
+arbitration, 8-eval pulls) reached 0.88x baseline.  The attacks here:
+TRANSFER — per-flag sensitivity mined from full-budget archives of the
+OTHER payloads over the same mined space (surrogate/screen.py), as a
+hard top-k restriction or a soft per-lane ARD reweighting — and the
+transfer-free ONLINE flip bias (per-flag |corr| over the run's own
+observations steering the pool's flip moves).  All three measured
+negative-to-neutral on qsort; see BENCHREPORT.md "Cross-payload
+screening on gcc-real (r5)".
 
 Phases (each resumable via its jsonl state):
   archives — full-80-eval baseline runs per payload, trials recorded to
              exp_archives/gccreal_<payload>_<seed>.jsonl
   run      — the screened surrogate-bandit arm on a target payload,
-             screen built from the OTHER payloads' archives; protocol
-             matches benchreport gcc-real v2 (same seeds 1000+, seeded
-             -O2 trial, 0.78x-anchor threshold, budget 80)
+             screen built from the OTHER payloads' archives
+  online   — the transfer-free online flip-bias arm
+
+Every arm runs the benchreport gcc-real protocol (same seeds 1000+,
+seeded -O2 trial, 0.78x-anchor threshold, budget 80) through the shared
+_run_arm loop, so arms stay protocol-identical by construction.
 
 Usage:
   python scripts/exp_screen_gccreal.py archives [--payloads qsort,mmm,stencil]
   python scripts/exp_screen_gccreal.py run --target qsort [--seeds 30]
-      [--top 16,24] [--state exp_screen_gccreal.jsonl]
+      [--top 16,24] [--soft] [--flip-only]
+  python scripts/exp_screen_gccreal.py online --target qsort [--seeds 10]
 
 MUST run on an otherwise idle box: the objective is measured binary
 runtime.
@@ -68,6 +75,58 @@ def gen_archives(payloads) -> None:
             jax.clear_caches()
 
 
+def _run_arm(target: str, arm: str, seeds: int, state_path: str,
+             sopts: dict, summary: str) -> None:
+    """Shared arm loop: resume from the jsonl state, run the missing
+    seeds under the benchreport gcc-real protocol (mode
+    'surrogate-bandit', budget 80, seeds 1000+), append rows, print the
+    summary.  Every arm routes through here so the arms stay
+    protocol-identical by construction."""
+    prob = _prob_name(target)
+    done = {}
+    if os.path.exists(state_path):
+        with open(state_path) as f:
+            for line in f:
+                r = json.loads(line)
+                done[(r["target"], r["arm"], r["seed"])] = r
+    rows = []
+    with open(state_path, "a") as out:
+        for s in range(seeds):
+            seed = 1000 + s
+            key = (target, arm, seed)
+            if key in done:
+                rows.append(done[key])
+                continue
+            r = one_run(prob, "surrogate-bandit", seed=seed, budget=80,
+                        sopts_override=dict(sopts))
+            r.update({"target": target, "arm": arm, "seed": seed})
+            rows.append(r)
+            out.write(json.dumps(r) + "\n")
+            out.flush()
+            import jax
+            jax.clear_caches()
+            print(f"  {target} {arm} seed={s} iters={r['iters']}"
+                  f"{' (censored)' if r['censored'] else ''}",
+                  file=sys.stderr)
+    iters = np.asarray([r["iters"] for r in rows])
+    print(json.dumps({
+        "arm": f"{target} {arm} ({summary})",
+        "seeds": len(rows),
+        "median_iters": float(np.median(iters)),
+        "iqr": [float(np.percentile(iters, 25)),
+                float(np.percentile(iters, 75))],
+        "censored": int(sum(r["censored"] for r in rows))}))
+
+
+def run_online(target: str, seeds: int, state_path: str) -> None:
+    """The online flip-bias arm: NO transfer, no screen — the plane's
+    flip moves are re-weighted at each refit by per-flag |corr| over
+    the run's own observations (manager flip_bias='online')."""
+    _run_arm(target, "online-flip", seeds, state_path,
+             {"propose_batch_parity": False, "flip_bias": "online"},
+             "bandit, batch 8, online flip bias")
+
+
 def run_screened(target: str, seeds: int, top: str, state_path: str,
                  flip_only: bool = False, soft: bool = False) -> None:
     from uptune_tpu.surrogate.screen import screen_from_archives
@@ -90,54 +149,22 @@ def run_screened(target: str, seeds: int, top: str, state_path: str,
           f"{others}, kept {sc.n_cont} cont lanes + {sc.n_cat} groups "
           f"({len(sc.idx)} of {space.n_surrogate_features} lanes)",
           file=sys.stderr)
-
-    done = {}
-    if os.path.exists(state_path):
-        with open(state_path) as f:
-            for line in f:
-                r = json.loads(line)
-                done[(r["target"], r["arm"], r["seed"])] = r
-    rows = []
     if flip_only:
         # ablation: keep the full-width GP, only bias the flip moves
         sc = sc._replace(idx=np.arange(space.n_surrogate_features,
                                        dtype=np.int32),
                          n_cont=space.n_cont_features,
                          n_cat=space.n_cat)
-    with open(state_path, "a") as out:
-        for s in range(seeds):
-            seed = 1000 + s
-            key = (target, arm, seed)
-            if key in done:
-                rows.append(done[key])
-                continue
-            sopts = {"propose_batch_parity": False, "screen": sc}
-            if soft:
-                sopts["screen_mode"] = "soft"
-            r = one_run(prob, "surrogate-bandit", seed=seed, budget=80,
-                        sopts_override=sopts)
-            r.update({"target": target, "arm": arm, "seed": seed})
-            rows.append(r)
-            out.write(json.dumps(r) + "\n")
-            out.flush()
-            import jax
-            jax.clear_caches()
-            print(f"  {target} {arm} seed={s} iters={r['iters']}"
-                  f"{' (censored)' if r['censored'] else ''}",
-                  file=sys.stderr)
-    iters = np.asarray([r["iters"] for r in rows])
-    print(json.dumps({
-        "arm": f"{target} {arm} (bandit, batch 8, screened)",
-        "seeds": len(rows),
-        "median_iters": float(np.median(iters)),
-        "iqr": [float(np.percentile(iters, 25)),
-                float(np.percentile(iters, 75))],
-        "censored": int(sum(r["censored"] for r in rows))}))
+    sopts = {"propose_batch_parity": False, "screen": sc}
+    if soft:
+        sopts["screen_mode"] = "soft"
+    _run_arm(target, arm, seeds, state_path, sopts,
+             "bandit, batch 8, screened")
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("phase", choices=("archives", "run"))
+    ap.add_argument("phase", choices=("archives", "run", "online"))
     ap.add_argument("--payloads", default=",".join(PAYLOADS))
     ap.add_argument("--target", default="qsort", choices=PAYLOADS)
     ap.add_argument("--seeds", type=int, default=10)
@@ -151,6 +178,8 @@ def main():
     args = ap.parse_args()
     if args.phase == "archives":
         gen_archives([p for p in args.payloads.split(",") if p])
+    elif args.phase == "online":
+        run_online(args.target, args.seeds, args.state)
     else:
         run_screened(args.target, args.seeds, args.top, args.state,
                      flip_only=args.flip_only, soft=args.soft)

@@ -231,3 +231,54 @@ class TestSoftScreen:
         space = _space()
         with pytest.raises(ValueError, match="screen_mode"):
             SurrogateManager(space, "gp", screen_mode="fuzzy")
+
+
+class TestOnlineFlipBias:
+    """flip_bias='online' (manager): per-group |corr| over the run's
+    OWN observations re-weights the pool's flip moves at each refit —
+    no transfer, no model narrowing."""
+
+    def test_online_weights_track_live_flags(self):
+        space = _space()
+        m = SurrogateManager(space, "gp", min_points=32,
+                             refit_interval=32,
+                             propose_batch=8, pool_mult=8,
+                             flip_bias="online")
+        cands = space.random(jax.random.PRNGKey(3), 96)
+        _, qor = _payload_data(space, seed=3, n=96)
+        m.observe(np.asarray(space.features(cands)), qor)
+        assert m.maybe_refit()
+        w = m._online_cat_w
+        assert w is not None and w.shape == (space.n_scalar,)
+        lanes = np.asarray(space.cat_lane_idx)
+        live = [lanes[0], lanes[3]]          # f0, f3 move QoR
+        dead = [l for l in lanes if l not in live]
+        assert min(w[l] for l in live) > max(w[l] for l in dead)
+        fp = np.asarray(m._flip_probs())
+        assert fp.shape == (space.n_scalar,)
+        # numeric lanes never flip; every cat lane keeps a floor share
+        num = [i for i in range(space.n_scalar) if i not in lanes]
+        assert all(fp[i] == 0 for i in num)
+        assert all(fp[l] > 0 for l in lanes)
+        # pool still proposes (flip_p is an argument, not a retrace)
+        pool = m.propose_pool(jax.random.PRNGKey(4), cands.u[0], (),
+                              float(qor.min()))
+        assert pool is not None and pool.batch == 8
+        # a second refit updates the weights without rebuilding the jit
+        cands2 = space.random(jax.random.PRNGKey(5), 32)
+        _, q2 = _payload_data(space, seed=5, n=32)
+        m.observe(np.asarray(space.features(cands2)), q2)
+        assert m.maybe_refit()      # 32 new rows >= refit_interval
+        assert m.propose_pool(jax.random.PRNGKey(6), cands.u[0], (),
+                              float(qor.min())) is not None
+
+    def test_uniform_without_bias(self):
+        space = _space()
+        m = SurrogateManager(space, "gp")
+        fp = np.asarray(m._flip_probs())
+        lanes = np.asarray(space.cat_lane_idx)
+        np.testing.assert_allclose(fp[lanes], 1.0 / space.n_cat)
+
+    def test_bad_value_rejected(self):
+        with pytest.raises(ValueError, match="flip_bias"):
+            SurrogateManager(_space(), "gp", flip_bias="upstream")

@@ -83,6 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'hard' restricts the model to the top-k lanes; "
                         "'soft' keeps full width and scales each lane "
                         "by its transferred sensitivity (per-lane ARD)")
+    p.add_argument("--surrogate-flip-bias", default=None,
+                   choices=("none", "online"),
+                   help="'online' re-ranks categorical params by "
+                        "|corr| with QoR over THIS run's observations "
+                        "at each refit and biases the proposal plane's "
+                        "flip moves toward them (75%% sensitivity / "
+                        "25%% uniform) — guides the bold moves without "
+                        "narrowing the model")
     p.add_argument("--seed-configuration", action="append", default=None,
                    metavar="JSON",
                    help="JSON file with a known-good configuration (or "
@@ -359,6 +367,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         sopts["screen"] = {"archives": list(args.surrogate_screen),
                            "top_cont": c, "top_cat": k}
         sopts["screen_mode"] = args.surrogate_screen_mode
+    if args.surrogate_flip_bias:
+        sopts = dict(sopts or {})
+        sopts["flip_bias"] = args.surrogate_flip_bias
     seed_cfgs = []
     for path in (args.seed_configuration or []):
         try:

@@ -59,7 +59,8 @@ class SurrogateManager:
                  auto_passive: bool = True,
                  arbitration: str = "schedule",
                  propose_batch_parity: bool = True,
-                 screen=None, screen_mode: str = "hard"):
+                 screen=None, screen_mode: str = "hard",
+                 flip_bias: str = "none"):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         if arbitration not in ("schedule", "bandit"):
@@ -161,18 +162,40 @@ class SurrogateManager:
         if screen_mode not in ("hard", "soft"):
             raise ValueError(f"unknown screen_mode {screen_mode!r}; "
                              f"known: hard, soft")
+        if flip_bias not in ("none", "online"):
+            raise ValueError(f"unknown flip_bias {flip_bias!r}; "
+                             f"known: none, online")
+        # flip_bias='online': at each refit, rank categorical groups by
+        # |Pearson r| against QoR over THIS RUN's own observations and
+        # bias the pool's flip moves toward them (75% sensitivity mass,
+        # 25% uniform).  The self-measured cousin of the cross-payload
+        # screen's flip weighting — it guides the plane's bold moves
+        # without narrowing the model's view (the gcc-real mechanism:
+        # bold exploration wins there, so steer the boldness).
+        self.flip_bias = flip_bias
+        self._online_cat_w = None
         self.screen = screen
         self.screen_mode = screen_mode
         self._screen_idx = None
         self._screen_w = None
         self._n_cont = space.n_cont_features
         self._n_cat = space.n_cat
+        # scalar categorical lanes backing the model's cat groups, in
+        # group order — the online flip-bias maps refit-time group
+        # sensitivities back onto flip probabilities through this
+        self._cat_groups = np.arange(space.n_cat)
         if screen is not None:
             if screen_mode == "hard":
                 # hard restriction: the model sees only the top-k lanes
                 self._n_cont = int(screen.n_cont)
                 self._n_cat = int(screen.n_cat)
                 self._screen_idx = jnp.asarray(screen.idx, jnp.int32)
+                if screen.n_cat and space.cat_max_codes:
+                    cat_part = np.asarray(
+                        screen.idx[screen.n_cont:], np.int64)
+                    self._cat_groups = np.unique(
+                        (cat_part - space.n_cont_features)
+                        // space.cat_max_codes)
             else:
                 # soft ARD: full width, per-lane sensitivity scaling —
                 # dead lanes' distances shrink instead of being cut
@@ -250,7 +273,8 @@ class SurrogateManager:
             return False
         if self.fitted and self._since_fit < self.refit_interval:
             return False
-        x = jnp.asarray(np.stack(self._xs))
+        xs_np = np.stack(self._xs)
+        x = jnp.asarray(xs_np)
         y = jnp.asarray(np.asarray(self._ys, np.float32))
         self._key, ks, kf = jax.random.split(self._key, 3)
         x, y = gp_mod.subsample(ks, x, y, self.max_points)
@@ -282,7 +306,41 @@ class SurrogateManager:
             np.quantile(finite, self.keep_quantile)) if finite else None
         self._best_y = float(np.min(finite)) if finite else None
         self._since_fit = 0
+        if self.flip_bias == "online" and self._n_cat:
+            # per-group |Pearson r| over this run's own rows -> flip
+            # weights on the backing scalar lanes (see __init__)
+            from .screen import lane_sensitivity
+            scores = lane_sensitivity(xs_np,
+                                      np.asarray(self._ys, np.float64))
+            width = self.space.cat_max_codes
+            gs = scores[self._n_cont:].reshape(
+                self._n_cat, width).max(axis=1)
+            w = np.zeros(self.space.n_scalar)
+            lanes = np.asarray(self.space.cat_lane_idx)[self._cat_groups]
+            w[lanes] = gs / gs.max() if gs.max() > 0 else 1.0
+            self._online_cat_w = w
         return True
+
+    def _flip_probs(self) -> jax.Array:
+        """[n_scalar] per-lane probability weights for the pool's
+        categorical flip moves: uniform by default; with an online
+        flip-bias or a transferred screen, 75% of the mass follows the
+        sensitivity ranking and 25% stays uniform so every flag remains
+        reachable."""
+        space = self.space
+        n_cat = space.n_cat
+        u = np.zeros(space.n_scalar)
+        if n_cat:
+            u[np.asarray(space.cat_lane_idx)] = 1.0 / n_cat
+        w = None
+        if self.flip_bias == "online":
+            w = self._online_cat_w
+        elif self.screen is not None:
+            w = self.screen.cat_weight
+        if w is None or not n_cat or float(np.sum(w)) <= 0:
+            return jnp.asarray(u, jnp.float32)
+        w = np.asarray(w, np.float64) / float(np.sum(w))
+        return jnp.asarray(0.75 * w + 0.25 * u, jnp.float32)
 
     # ------------------------------------------------------------------
     def keep_mask(self, cands: CandBatch,
@@ -378,19 +436,6 @@ class SurrogateManager:
             jnp.asarray(space.cat_lane_idx, jnp.int32)].set(1.0) \
             if space.n_cat else jnp.zeros(space.n_scalar)
         max_flips = max(2, space.n_cat // 8)
-        # per-lane flip probability: uniform over categorical lanes by
-        # default; with a FeatureScreen installed, 75% of the flip mass
-        # follows the transferred per-flag sensitivity (flags that moved
-        # QoR on the source payloads get proportionally more mutation)
-        # and 25% stays uniform so unscreened flags remain reachable
-        u_norm = cat_row / max(space.n_cat, 1)
-        if self.screen is not None and space.n_cat:
-            w = jnp.asarray(self.screen.cat_weight, jnp.float32)
-            wsum = float(np.asarray(self.screen.cat_weight).sum())
-            w_norm = (w / wsum) if wsum > 0 else u_norm
-            flip_p = 0.75 * w_norm + 0.25 * u_norm
-        else:
-            flip_p = u_norm
         kind = self.kind
         score_ei = self.score_kind == "ei"
         nc, ncat = self._n_cont, self._n_cat
@@ -403,7 +448,7 @@ class SurrogateManager:
         use_pallas = (kind == "gp" and pool >= pallas_score.PALLAS_MIN_POOL)
         from ..ops import perm as perm_ops
 
-        def pool_fn(state, key, best_u, best_perms, best_y):
+        def pool_fn(state, key, best_u, best_perms, best_y, flip_p):
             kr, kn, ks, kp, km, kv, kw, kf1, kf2, kf3 = \
                 jax.random.split(key, 10)
             rand = space.random(kr, n_rand)
@@ -502,4 +547,5 @@ class SurrogateManager:
         if self._pool_jit is None:
             self._pool_jit = self._build_pool_fn()
         return self._pool_jit(self._state, key, best_u, best_perms,
-                              jnp.asarray(best_y, jnp.float32))
+                              jnp.asarray(best_y, jnp.float32),
+                              self._flip_probs())
