@@ -60,7 +60,14 @@ print("UT_ROW=" + json.dumps({
 
 LADDER = [(64, 15, 200),   # the headline sizing, as the anchor
           (128, 16, 100),
-          (256, 17, 100)]
+          (256, 17, 100),
+          # r5: two more rungs — with the merge-based history insert
+          # (driver/history.py) the per-step sort no longer grows with
+          # capacity, so the ladder should keep climbing while the
+          # program is latency-bound (~5 ms/step at 6k batch).  Fewer
+          # steps per rung keeps compile+run inside the 900 s kill.
+          (512, 17, 50),
+          (1024, 18, 50)]
 
 
 def main() -> None:
