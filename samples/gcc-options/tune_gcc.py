@@ -12,6 +12,22 @@ Payload selection (UT_GCC_PAYLOAD): `mmm` (default) = the tutorial's
 blocked matmul, with BLOCK_SIZE tuned alongside the compiler space;
 `qsort` = sort/arithmetic benchmark.  QoR = best-of-3 wall time of the
 compiled binary (seconds); failed compiles report +inf.
+
+Budget-constrained recipes (r5, measured at 30 matched seeds per
+BENCHREPORT.md — on this space a default `--learning-models gp` run
+automatically applies the bandit-arbitrated surrogate plane and
+measured 0.86x the bandit baseline with a perfect solve rate):
+
+    # warm-start from a previous run's best (or any known-good flags)
+    ut samples/gcc-options/tune_gcc.py --test-limit 80 \
+        --seed-configuration best_flags.json
+
+    # transfer per-flag sensitivity mined from ANOTHER payload's
+    # archive over this same space (off by default — measured
+    # payload-specific; see BENCHREPORT "Cross-payload screening")
+    ut samples/gcc-options/tune_gcc.py --learning-models gp \
+        --surrogate-screen other_payload.archive.jsonl \
+        --surrogate-screen-mode soft
 """
 import math
 import os
