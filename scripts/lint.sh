@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Pre-commit gate: ut-lint over uptune_tpu/, failing on NEW findings.
+#
+# Grandfathered findings (if any) live in scripts/lint_baseline.json;
+# the tree is currently clean, so no baseline file exists.  If a rule
+# lands that flags legacy code you cannot fix in the same change,
+# refresh the baseline once:
+#
+#   python -m uptune_tpu.analysis uptune_tpu/ bench.py scripts/ \
+#       --write-baseline scripts/lint_baseline.json
+#
+# (the path set must match the gate invocation below, or findings
+# outside uptune_tpu/ can never be grandfathered)
+#
+# and fix the grandfathered findings down over time.  Intentional
+# hazards get a per-line '# ut-lint: disable=R00X' with a rationale
+# comment instead (docs/LINT.md).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+args=(uptune_tpu/ bench.py scripts/ --format text)
+if [ -f scripts/lint_baseline.json ]; then
+    args+=(--baseline scripts/lint_baseline.json)
+fi
+exec "${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"

@@ -91,9 +91,14 @@ def run(n: int = 600, n_feat: int = 94, n_test: int = 300,
     import jax.numpy as jnp
     from uptune_tpu.surrogate import gp, mlp
 
+    # R005 suppressions below: each jax.jit(f)(x) wrapper in this
+    # one-shot report script runs exactly once per process, so there is
+    # no cache to miss — and fit_s deliberately INCLUDES compile time
+    # (that is the cost a user pays on first fit)
     t0 = time.time()
-    state = jax.jit(gp.fit_auto)(jnp.asarray(xtr), jnp.asarray(ytr))
-    mu, _ = jax.jit(gp.predict)(state, jnp.asarray(xte))
+    state = jax.jit(gp.fit_auto)(                 # ut-lint: disable=R005
+        jnp.asarray(xtr), jnp.asarray(ytr))
+    mu, _ = jax.jit(gp.predict)(state, jnp.asarray(xte))  # ut-lint: disable=R005
     out["gp_mll"] = {
         "spearman": spearman(yte, np.asarray(mu)),
         "p_at_10": precision_at(yte, np.asarray(mu)),
@@ -103,9 +108,9 @@ def run(n: int = 600, n_feat: int = 94, n_test: int = 300,
     }
 
     t0 = time.time()
-    state_f = jax.jit(lambda x, y: gp.fit(x, y))(
+    state_f = jax.jit(lambda x, y: gp.fit(x, y))(  # ut-lint: disable=R005
         jnp.asarray(xtr), jnp.asarray(ytr))
-    mu_f, _ = jax.jit(gp.predict)(state_f, jnp.asarray(xte))
+    mu_f, _ = jax.jit(gp.predict)(state_f, jnp.asarray(xte))  # ut-lint: disable=R005
     out["gp_fixed"] = {
         "spearman": spearman(yte, np.asarray(mu_f)),
         "p_at_10": precision_at(yte, np.asarray(mu_f)),
@@ -113,9 +118,9 @@ def run(n: int = 600, n_feat: int = 94, n_test: int = 300,
     }
 
     t0 = time.time()
-    ms = jax.jit(lambda k, x, y: mlp.fit(k, x, y))(
+    ms = jax.jit(lambda k, x, y: mlp.fit(k, x, y))(  # ut-lint: disable=R005
         jax.random.PRNGKey(seed), jnp.asarray(xtr), jnp.asarray(ytr))
-    mmu, _ = jax.jit(mlp.predict)(ms, jnp.asarray(xte))
+    mmu, _ = jax.jit(mlp.predict)(ms, jnp.asarray(xte))  # ut-lint: disable=R005
     out["mlp_ens"] = {
         "spearman": spearman(yte, np.asarray(mmu)),
         "p_at_10": precision_at(yte, np.asarray(mmu)),

@@ -1,0 +1,630 @@
+"""ut-lint: fixture-proven true positives/negatives per rule, the
+suppression syntax, reporters, the trace guard, and the repo-clean gate
+that wires `scripts/lint.sh` into tier-1.
+
+Fixture snippets are linted as strings (lint_source) — no files, no
+jax import on the static side.  The trace-guard tests run real jit
+under the CPU platform forced by conftest.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from uptune_tpu.analysis import lint_source
+from uptune_tpu.analysis.reporters import format_json, format_sarif, \
+    format_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fixture(src):
+    """Dedent a triple-quoted fixture and drop its leading blank line
+    so asserted line numbers match what the snippet reads like."""
+    return textwrap.dedent(src).lstrip("\n")
+
+
+def active(src, rule=None):
+    """Non-suppressed findings for a dedented fixture snippet."""
+    fs = lint_source("fixture.py", fixture(src))
+    assert not any(f.rule == "E000" for f in fs), \
+        f"fixture failed to parse: {fs}"
+    fs = [f for f in fs if not f.suppressed]
+    if rule is not None:
+        fs = [f for f in fs if f.rule == rule]
+    return fs
+
+
+def suppressed(src, rule):
+    fs = lint_source("fixture.py", fixture(src))
+    return [f for f in fs if f.suppressed and f.rule == rule]
+
+
+# ---------------------------------------------------------------- R001
+class TestHostSync:
+    def test_positive_float_cast(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) + 1.0
+        """, "R001")
+        assert len(fs) == 1 and fs[0].line == 5
+
+    def test_positive_item_in_scan_body(self):
+        fs = active("""
+            import jax
+
+            def outer(xs):
+                def body(carry, x):
+                    return carry + x.item(), None
+                return jax.lax.scan(body, 0.0, xs)
+        """, "R001")
+        assert len(fs) == 1
+
+    def test_positive_np_asarray(self):
+        fs = active("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x).sum()
+        """, "R001")
+        assert len(fs) == 1
+
+    def test_negative_static_math_and_host_fn(self):
+        # float() on a closure constant under jit, and float() on a
+        # traced-looking value in a NON-jitted function: both fine
+        fs = active("""
+            import jax
+            import numpy as np
+
+            D = 16
+
+            @jax.jit
+            def f(x):
+                scale = float(np.log2(D))
+                return x * scale
+
+            def report(x):
+                return float(x)
+        """, "R001")
+        assert fs == []
+
+    def test_negative_shape_metadata(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x * float(x.shape[0])
+        """, "R001")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)  # ut-lint: disable=R001
+        """
+        assert active(src, "R001") == []
+        assert len(suppressed(src, "R001")) == 1
+
+
+# ---------------------------------------------------------------- R002
+class TestKeyReuse:
+    def test_positive_straight_line(self):
+        fs = active("""
+            import jax
+
+            def f(key):
+                a = jax.random.uniform(key, (3,))
+                b = jax.random.normal(key, (3,))
+                return a + b
+        """, "R002")
+        assert len(fs) == 1 and fs[0].line == 5
+
+    def test_positive_loop_without_split(self):
+        fs = active("""
+            import jax
+
+            def f(key):
+                out = []
+                for _ in range(4):
+                    out.append(jax.random.uniform(key, (2,)))
+                return out
+        """, "R002")
+        assert len(fs) == 1
+
+    def test_positive_inline_prngkey(self):
+        fs = active("""
+            import jax
+
+            def f():
+                return jax.random.uniform(jax.random.PRNGKey(0), (2,))
+        """, "R002")
+        assert len(fs) == 1
+
+    def test_negative_split_idiom(self):
+        fs = active("""
+            import jax
+
+            def f(key):
+                key, k1 = jax.random.split(key)
+                a = jax.random.uniform(k1, (3,))
+                key, k2 = jax.random.split(key)
+                return a + jax.random.normal(k2, (3,))
+        """, "R002")
+        assert fs == []
+
+    def test_negative_branches_are_exclusive(self):
+        fs = active("""
+            import jax
+
+            def f(key, flag):
+                if flag:
+                    return jax.random.uniform(key, (2,))
+                else:
+                    return jax.random.normal(key, (2,))
+        """, "R002")
+        assert fs == []
+
+    def test_positive_comprehension_reuse(self):
+        # same hazard as the for-loop form: every comprehension
+        # iteration replays the same key
+        fs = active("""
+            import jax
+
+            def f(key):
+                return [jax.random.uniform(key, (2,))
+                        for _ in range(3)]
+        """, "R002")
+        assert len(fs) == 1
+
+    def test_negative_split_in_comprehension(self):
+        # the standard idiom: each iteration binds a FRESH child key
+        fs = active("""
+            import jax
+
+            def f(key):
+                return [jax.random.uniform(k, (2,))
+                        for k in jax.random.split(key, 3)]
+        """, "R002")
+        assert fs == []
+
+    def test_negative_fold_in_loop(self):
+        fs = active("""
+            import jax
+
+            def f(key):
+                return [jax.random.uniform(jax.random.fold_in(key, i),
+                                           (2,))
+                        for i in range(3)]
+        """, "R002")
+        # fold_in derives decorrelated streams; the inline consumer is
+        # fold_in's RESULT, not a constant PRNGKey
+        assert fs == []
+
+    def test_negative_self_attr_rebind_in_loop(self):
+        fs = active("""
+            import jax
+
+            class T:
+                def f(self):
+                    ks = []
+                    for _ in range(3):
+                        self.key, k = jax.random.split(self.key)
+                        ks.append(jax.random.uniform(k, (2,)))
+                    return ks
+        """, "R002")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import jax
+
+            def f():
+                k = 0
+                # ut-lint: disable-next=R002
+                return jax.random.uniform(jax.random.PRNGKey(0), (2,))
+        """
+        assert active(src, "R002") == []
+        assert len(suppressed(src, "R002")) == 1
+
+
+# ---------------------------------------------------------------- R003
+class TestTracedControlFlow:
+    def test_positive_if_on_param(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """, "R003")
+        assert len(fs) == 1 and fs[0].line == 5
+
+    def test_positive_while_on_jnp(self):
+        fs = active("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                while jnp.any(x > 0):
+                    x = x - 1
+                return x
+        """, "R003")
+        assert len(fs) == 1
+
+    def test_negative_none_check_and_shape(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x, eval_fn=None):
+                if eval_fn is None:
+                    x = x * 2
+                while x.ndim < 4:
+                    x = x[None]
+                return x
+        """, "R003")
+        assert fs == []
+
+    def test_negative_static_config(self):
+        fs = active("""
+            import jax
+
+            class T:
+                def __init__(self, dedup):
+                    self.dedup = dedup
+
+                def step(self, state):
+                    def body(s, _):
+                        if self.dedup:
+                            s = s + 1
+                        return s, None
+                    return jax.lax.scan(body, state, None, length=3)
+        """, "R003")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:  # ut-lint: disable=R003
+                    return x
+                return -x
+        """
+        assert active(src, "R003") == []
+        assert len(suppressed(src, "R003")) == 1
+
+
+# ---------------------------------------------------------------- R004
+class TestSideEffects:
+    def test_positive_print(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """, "R004")
+        assert len(fs) == 1
+
+    def test_positive_global_and_open(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                global COUNT
+                COUNT = COUNT + 1
+                with open("log.txt", "a") as fh:
+                    fh.write("step")
+                return x
+        """, "R004")
+        assert len(fs) == 2
+
+    def test_negative_host_side_print(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x * 2
+
+            def drive(x):
+                y = f(x)
+                print(y)
+                return y
+        """, "R004")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                print("tracing f")  # ut-lint: disable=R004
+                return x
+        """
+        assert active(src, "R004") == []
+        assert len(suppressed(src, "R004")) == 1
+
+
+# ---------------------------------------------------------------- R005
+class TestRetraceChurn:
+    def test_positive_jit_in_loop(self):
+        fs = active("""
+            import jax
+
+            def f(xs):
+                out = []
+                for x in xs:
+                    g = jax.jit(lambda v: v + 1)
+                    out.append(g(x))
+                return out
+        """, "R005")
+        assert len(fs) == 1
+
+    def test_positive_immediate_invocation(self):
+        fs = active("""
+            import jax
+
+            def f(x):
+                return jax.jit(lambda v: v * 2)(x)
+        """, "R005")
+        assert len(fs) == 1
+
+    def test_negative_parameterized_decorator(self):
+        # `@jax.jit(donate_argnums=0)` is definition-time jitting, not
+        # wrapper churn
+        fs = active("""
+            import jax
+
+            @jax.jit(donate_argnums=0)
+            def f(x):
+                return x * 2
+
+            def outer(xs):
+                @jax.jit(donate_argnums=0)
+                def g(x):
+                    return x + 1
+                return [g(x) for x in xs]
+        """, "R005")
+        assert fs == []
+
+    def test_negative_module_level_and_keyed_cache(self):
+        fs = active("""
+            import jax
+
+            def _impl(v):
+                return v + 1
+
+            g = jax.jit(_impl)
+
+            class T:
+                def __init__(self, fns):
+                    self._jit = {}
+                    for name, fn in fns.items():
+                        self._jit[name] = jax.jit(fn)
+        """, "R005")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import jax
+
+            def f(x):
+                return jax.jit(lambda v: v * 2)(x)  # ut-lint: disable=R005
+        """
+        assert active(src, "R005") == []
+        assert len(suppressed(src, "R005")) == 1
+
+
+# ------------------------------------------------------------ engine
+class TestEngine:
+    def test_disable_all(self):
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                print(float(x))  # ut-lint: disable=all
+                return x
+        """)
+        assert fs == []
+
+    def test_syntax_error_is_reported_not_raised(self):
+        fs = lint_source("broken.py", "def f(:\n")
+        assert len(fs) == 1 and fs[0].rule == "E000"
+
+    def test_reporters(self):
+        fs = lint_source("fixture.py", fixture("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        txt = format_text(fs)
+        assert "R001" in txt and "fixture.py:5" in txt
+        doc = json.loads(format_json(fs))
+        assert doc["summary"]["total"] == 1
+        assert doc["findings"][0]["rule"] == "R001"
+        sarif = json.loads(format_sarif(fs))
+        assert sarif["version"] == "2.1.0"
+        run = sarif["runs"][0]
+        assert run["results"][0]["ruleId"] == "R001"
+        ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert {"R001", "R002", "R003", "R004", "R005"} <= ids
+
+    def test_identical_findings_get_distinct_fingerprints(self):
+        # a NEW hazard textually identical to a baselined one must NOT
+        # inherit its fingerprint (it would be silently grandfathered)
+        fs = active("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+
+            @jax.jit
+            def g(x):
+                return float(x)
+        """, "R001")
+        assert len(fs) == 2
+        assert fs[0].fingerprint() != fs[1].fingerprint()
+
+    def test_cli_baseline_grandfathers(self, tmp_path):
+        bad = tmp_path / "mod.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        base = tmp_path / "baseline.json"
+        env = {**os.environ, "PYTHONPATH": REPO}
+        common = [sys.executable, "-m", "uptune_tpu.analysis", str(bad)]
+        r = subprocess.run(common, capture_output=True, text=True,
+                           env=env, cwd=str(tmp_path))
+        assert r.returncode == 1, r.stdout + r.stderr
+        r = subprocess.run(common + ["--write-baseline", str(base)],
+                           capture_output=True, text=True, env=env,
+                           cwd=str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(common + ["--baseline", str(base)],
+                           capture_output=True, text=True, env=env,
+                           cwd=str(tmp_path))
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_parse_errors_are_never_grandfathered(self, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        base = tmp_path / "baseline.json"
+        env = {**os.environ, "PYTHONPATH": REPO}
+        common = [sys.executable, "-m", "uptune_tpu.analysis", str(bad)]
+        r = subprocess.run(common + ["--write-baseline", str(base)],
+                           capture_output=True, text=True, env=env,
+                           cwd=str(tmp_path))
+        assert "refusing to baseline" in r.stderr
+        assert json.loads(base.read_text())["fingerprints"] == []
+        r = subprocess.run(common + ["--baseline", str(base)],
+                           capture_output=True, text=True, env=env,
+                           cwd=str(tmp_path))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "E000" in r.stdout
+
+
+# ------------------------------------------------------- trace guard
+class TestTraceGuard:
+    def test_counts_retraces_and_warns(self):
+        import jax
+        import jax.numpy as jnp
+
+        from uptune_tpu.analysis import TraceGuard
+        with pytest.warns(RuntimeWarning, match="unexpected recompile"):
+            with TraceGuard(limit=1) as tg:
+                @jax.jit
+                def f(x):
+                    return x * 2.0
+                f(jnp.ones((3,)))
+                f(jnp.ones((3,)))    # cache hit: no new trace
+                f(jnp.ones((4,)))    # new shape: retrace
+        label = next(iter(tg.counts))
+        assert tg.counts[label] == 2
+        assert tg.excess() == {label: 2}
+        assert tg.report()["limit"] == 1
+
+    def test_within_budget_is_silent(self):
+        import jax
+        import jax.numpy as jnp
+        import warnings
+
+        from uptune_tpu.analysis import TraceGuard
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            with TraceGuard(limit=1) as tg:
+                @jax.jit
+                def f(x):
+                    return x + 1.0
+                f(jnp.ones((3,)))
+                f(jnp.ones((3,)))
+        assert list(tg.counts.values()) == [1]
+
+    def test_detects_rebuilt_wrapper_churn(self):
+        # every wrapper traces once, but rebuilding one per call is a
+        # fresh compile each time — the R005 hazard, caught dynamically
+        import jax
+        import jax.numpy as jnp
+
+        from uptune_tpu.analysis import TraceGuard
+        with pytest.warns(RuntimeWarning, match="rebuilt after trace"):
+            with TraceGuard(limit=1) as tg:
+                def impl(x):
+                    return x * 2.0
+                for _ in range(4):
+                    jax.jit(impl)(jnp.ones((2,)))
+        rb = tg.report()["rebuilds"]
+        assert list(rb.values()) == [3]
+        assert all(v == 1 for v in tg.counts.values())
+
+    def test_wrapper_fleet_built_upfront_is_clean(self):
+        # N wrappers from one code object, all built BEFORE anything
+        # runs (the driver's per-technique jit loop): not churn
+        import jax
+        import jax.numpy as jnp
+        import warnings
+
+        from uptune_tpu.analysis import TraceGuard
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            with TraceGuard(limit=1) as tg:
+                fns = [jax.jit(lambda x, s=float(i): x * s)
+                       for i in range(4)]
+                for fn in fns:
+                    fn(jnp.ones((2,)))
+        assert tg.rebuilds == {}
+        assert all(v == 1 for v in tg.counts.values())
+
+    def test_strict_raises_and_restores_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        from uptune_tpu.analysis import RetraceError, TraceGuard
+        orig = jax.jit
+        with pytest.raises(RetraceError):
+            with TraceGuard(limit=0, strict=True):
+                @jax.jit
+                def f(x):
+                    return x - 1.0
+                f(jnp.ones((2,)))
+        assert jax.jit is orig
+
+
+# ------------------------------------------------------- repo gate
+def test_repo_clean():
+    """scripts/lint.sh (the pre-commit gate) must pass on the tree:
+    zero non-suppressed ut-lint findings in uptune_tpu/."""
+    r = subprocess.run(["bash", os.path.join(REPO, "scripts", "lint.sh")],
+                       capture_output=True, text=True, cwd=REPO,
+                       env={**os.environ, "PYTHONPATH": REPO,
+                            "PYTHON": sys.executable})
+    assert r.returncode == 0, (
+        f"ut-lint found new hazards:\n{r.stdout}\n{r.stderr}")

@@ -1,0 +1,21 @@
+"""ut-lint: JAX-hazard static analysis for uptune-tpu, plus the runtime
+trace guard that cross-checks it.
+
+Static side (no jax import — runs on any box)::
+
+    python -m uptune_tpu.analysis uptune_tpu/ --format json
+    ut-lint --list-rules
+
+Runtime side::
+
+    from uptune_tpu.analysis import TraceGuard
+    with TraceGuard(limit=2) as tg:
+        ...   # anything jitted in here gets its traces counted
+
+Rules, suppression syntax, and the throughput rationale: docs/LINT.md.
+"""
+from .core import Finding, all_rules, lint_paths, lint_source
+from .trace_guard import RetraceError, TraceGuard, guard_from_env
+
+__all__ = ["Finding", "all_rules", "lint_paths", "lint_source",
+           "TraceGuard", "RetraceError", "guard_from_env"]

@@ -1,0 +1,124 @@
+"""ut-lint CLI: `python -m uptune_tpu.analysis [paths...]`.
+
+Exit codes: 0 clean (no non-suppressed, non-baselined findings),
+1 findings, 2 usage error.  `--write-baseline` grandfathers the current
+findings so `scripts/lint.sh` fails only on NEW hazards.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Set
+
+from .core import Finding, all_rules, lint_paths
+from .reporters import format_json, format_sarif, format_text
+
+
+def _load_baseline(path: str) -> Set[str]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return set(doc.get("fingerprints", []))
+
+
+def _write_baseline(path: str, findings: List[Finding]) -> int:
+    # E000 (parse error) is never baselined: its fingerprint is
+    # location-independent, so grandfathering one syntax error would
+    # exempt the file from every rule forever
+    broken = sorted({f.path for f in findings if f.rule == "E000"})
+    if broken:
+        print(f"ut-lint: refusing to baseline unparseable file(s): "
+              f"{broken} — fix the syntax errors first",
+              file=sys.stderr)
+    fps = sorted({f.fingerprint() for f in findings
+                  if not f.suppressed and f.rule != "E000"})
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"tool": "ut-lint", "fingerprints": fps}, f, indent=1)
+        f.write("\n")
+    return len(fps)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ut-lint",
+        description="JAX-hazard static analysis for uptune-tpu "
+                    "(see docs/LINT.md)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         "(default: uptune_tpu/)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--select", metavar="R001,R002",
+                    help="run only these rule ids")
+    ap.add_argument("--disable", metavar="R00X,...",
+                    help="skip these rule ids")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="ignore findings whose fingerprint is in this "
+                         "baseline file (grandfathered)")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write current findings as the new baseline "
+                         "and exit 0")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include '# ut-lint: disable' findings in "
+                         "text/json output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    rules = all_rules()
+    if args.list_rules:
+        for rid, r in sorted(rules.items()):
+            print(f"{rid}  {r.name:24s} {r.short}")
+        return 0
+
+    select: Optional[Set[str]] = None
+    if args.select:
+        select = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = select - set(rules)
+        if unknown:
+            print(f"ut-lint: unknown rule id(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+    if args.disable:
+        disabled = {r.strip() for r in args.disable.split(",")
+                    if r.strip()}
+        unknown = disabled - set(rules)
+        if unknown:
+            print(f"ut-lint: unknown rule id(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        select = (select or set(rules)) - disabled
+
+    paths = args.paths or ["uptune_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"ut-lint: no such path(s): {missing}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths, select)
+
+    if args.write_baseline:
+        n = _write_baseline(args.write_baseline, findings)
+        print(f"ut-lint: baseline with {n} fingerprint(s) written to "
+              f"{args.write_baseline}", file=sys.stderr)
+        return 0
+
+    if args.baseline and os.path.exists(args.baseline):
+        grandfathered = _load_baseline(args.baseline)
+        findings = [f for f in findings
+                    if f.rule == "E000"       # parse errors never pass
+                    or f.suppressed
+                    or f.fingerprint() not in grandfathered]
+
+    if args.format == "text":
+        print(format_text(findings, args.show_suppressed))
+    elif args.format == "json":
+        print(format_json(findings, args.show_suppressed))
+    else:
+        print(format_sarif(findings))
+
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

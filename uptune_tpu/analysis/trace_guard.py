@@ -1,0 +1,161 @@
+"""Runtime cross-check for the static rules: count jax.jit traces.
+
+Static analysis says "this pattern CAN retrace"; the TraceGuard says
+"this run DID retrace N times".  Inside the guard's scope every
+function handed to `jax.jit` is shimmed so its *Python* body — which
+executes only while JAX is tracing — bumps a per-function counter.
+Cached executions never enter the Python body, so the counter is
+exactly the trace count.
+
+    with TraceGuard(limit=2) as tg:
+        run_benchmark()
+    tg.check()          # warns (or raises, strict=True) on excess
+
+Scope notes:
+
+* only `jax.jit` wrappers CREATED inside the scope are counted — a
+  function jitted before entering the guard keeps its original shim-less
+  body (wrap long-lived tuners inside the guard, as bench.py does);
+* call sites must resolve `jax.jit` at call time (the `jax.jit(...)` /
+  `@jax.jit` attribute style this repo uses everywhere); `from jax
+  import jit` binds early and escapes the patch — such wrappers are
+  simply not counted;
+* an expected-trace budget of `limit` per function: 1 for a single
+  shape, +1 per distinct input shape/dtype/static-arg combination you
+  intend to run.  Anything above is the retrace churn R005 hunts.
+  Wrappers REBUILT from an already-traced function are budgeted too
+  (each rebuild is a fresh compile even though every individual
+  wrapper traces once), so `jax.jit(f)(x)` in a loop is caught.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import warnings
+from typing import Dict, Optional
+
+__all__ = ["TraceGuard", "RetraceError"]
+
+_lock = threading.Lock()
+
+
+class RetraceError(RuntimeError):
+    """Raised by TraceGuard(strict=True) when a function exceeded its
+    trace budget."""
+
+
+class TraceGuard:
+    def __init__(self, limit: int = 2, strict: bool = False,
+                 name: str = "trace-guard", enabled: bool = True):
+        self.limit = int(limit)
+        self.strict = strict
+        self.name = name
+        self.enabled = enabled   # False = inert context, jit untouched
+        self.counts: Dict[str, int] = {}
+        self.rebuilds: Dict[str, int] = {}
+        self._orig_jit = None
+        self._label_seen: Dict[str, int] = {}
+        # code objects that traced at least once, kept by strong ref so
+        # ids cannot be recycled by the GC mid-guard
+        self._traced_codes: Dict[int, object] = {}
+
+    # -- bookkeeping --------------------------------------------------
+    def record(self, label: str) -> None:
+        with _lock:
+            self.counts[label] = self.counts.get(label, 0) + 1
+
+    def excess(self) -> Dict[str, int]:
+        """{function label: count} for functions over the limit —
+        either traces of one wrapper, or wrappers rebuilt from the same
+        code object after it already traced (churn: the compile cache
+        keys on the wrapper, so every rebuild pays a fresh compile)."""
+        ex = {k: v for k, v in self.counts.items() if v > self.limit}
+        for k, v in self.rebuilds.items():
+            if v > self.limit:
+                ex[f"{k} (rebuilt after trace)"] = v
+        return ex
+
+    def report(self) -> Dict[str, object]:
+        return {"limit": self.limit, "traces": dict(self.counts),
+                "rebuilds": dict(self.rebuilds),
+                "excess": self.excess()}
+
+    def check(self) -> None:
+        """Warn (or raise, strict=True) if any function re-traced or
+        was rebuilt past the budget."""
+        ex = self.excess()
+        if not ex:
+            return
+        detail = ", ".join(f"{k}: {v}" for k, v in sorted(ex.items()))
+        msg = (f"{self.name}: unexpected recompiles (limit "
+               f"{self.limit}) — {detail}. Likely causes: unhashed "
+               f"Python scalars in static args, shape-varying inputs, "
+               f"or a jit wrapper rebuilt per call (ut-lint R005).")
+        if self.strict:
+            raise RetraceError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=2)
+
+    # -- the patch ----------------------------------------------------
+    def _counting_jit(self, fun=None, **jit_kwargs):
+        if fun is None:
+            # jax.jit(static_argnums=...)(f) keyword-only usage
+            return lambda f: self._counting_jit(f, **jit_kwargs)
+        base = getattr(fun, "__qualname__",
+                       getattr(fun, "__name__", repr(fun)))
+        # the TRACE budget is per WRAPPER, not per qualname: the driver
+        # jits one <lambda> per technique arm, and aggregating those
+        # would read as retrace churn when each wrapper traced exactly
+        # once.  Churn from wrappers REBUILT per call is caught
+        # separately: constructing another wrapper from a code object
+        # that already traced counts toward the same budget (building a
+        # fleet of wrappers up-front, before anything runs, does not).
+        code = getattr(fun, "__code__", None)
+        with _lock:
+            n = self._label_seen.get(base, 0)
+            self._label_seen[base] = n + 1
+            if code is not None and id(code) in self._traced_codes:
+                self.rebuilds[base] = self.rebuilds.get(base, 0) + 1
+        label = f"{base}#{n + 1}" if n else base
+
+        @functools.wraps(fun)
+        def traced(*args, **kwargs):
+            if code is not None:
+                with _lock:
+                    self._traced_codes[id(code)] = code
+            self.record(label)
+            return fun(*args, **kwargs)
+
+        return self._orig_jit(traced, **jit_kwargs)
+
+    def __enter__(self) -> "TraceGuard":
+        if not self.enabled:
+            return self
+        import jax
+        self._jax = jax
+        self._orig_jit = jax.jit
+        jax.jit = self._counting_jit
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if not self.enabled:
+            return
+        self._jax.jit = self._orig_jit
+        if exc_type is None:
+            self.check()
+
+
+def guard_from_env(env: Optional[dict] = None) -> TraceGuard:
+    """TraceGuard configured from UT_TRACE_GUARD[_LIMIT/_STRICT] env
+    vars — the bench.py / `ut` CLI hook.  Always returns a guard; when
+    the env var is unset it is an inert context (enabled=False, jit
+    untouched), so call sites are a plain `with guard_from_env() as g`
+    plus an `if g.enabled` around reporting."""
+    import os
+    e = os.environ if env is None else env
+    if e.get("UT_TRACE_GUARD", "") not in ("1", "true", "yes", "warn",
+                                           "strict"):
+        return TraceGuard(enabled=False)
+    return TraceGuard(
+        limit=int(e.get("UT_TRACE_GUARD_LIMIT", "2")),
+        strict=(e.get("UT_TRACE_GUARD", "") == "strict"
+                or e.get("UT_TRACE_GUARD_STRICT", "") == "1"))

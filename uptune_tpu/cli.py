@@ -414,8 +414,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{math.log10(size) if size else 0:.2f}")
         return 0
 
+    from .analysis.trace_guard import guard_from_env
     from .exec.multistage import run_auto
-    res = run_auto(pt)   # single / multi-stage / decouple auto-dispatch
+    # UT_TRACE_GUARD=1|strict: count per-function jit traces over the
+    # whole tune (docs/LINT.md) — the proposal plane must compile once
+    # per technique, not once per step
+    with guard_from_env() as guard:
+        res = run_auto(pt)   # single / multi-stage / decouple dispatch
+    if guard.enabled:
+        log.info("[ut] trace-guard: %s", json.dumps(guard.report()))
     log.info("[ut] done: best qor=%.6g evals=%d", res.best_qor, res.evals)
     print(json.dumps({"best_config": res.best_config,
                       "best_qor": res.best_qor, "evals": res.evals}))

@@ -177,8 +177,13 @@ class _TargetModel:
             sel = order[:1]
         self.sel = np.sort(sel)
 
+        # intentional seed-derived key: a QuickEst model is a pure
+        # function of (training data, seed) — refits on the same rows
+        # must reproduce bit-identically, so there is no stored key to
+        # split
         self.mlp_state = mlp_mod.fit(
-            jax.random.PRNGKey(self.seed), jnp.asarray(xs[tr][:, self.sel]),
+            jax.random.PRNGKey(self.seed),
+            jnp.asarray(xs[tr][:, self.sel]),
             jnp.asarray(ys[tr]), n_members=self.n_members,
             steps=self.mlp_steps)
         lin_va = xs[va] @ self.w + self.b
