@@ -173,7 +173,78 @@ def _utilization(device_kind: str, flops_per_s, bytes_per_s):
     return {}
 
 
+def driver_main() -> None:
+    """`bench.py --driver`: the driver-plane microbenchmark — asks/sec
+    through the host Tuner's ask()/tell() surface against an instant
+    dummy evaluator (no subprocesses), i.e. the pure dispatch cost an
+    external build pipeline has to hide.  Prints ONE JSON line next to
+    the fused-plane headline metric and writes BENCH_DRIVER.json; run
+    under UT_TRACE_GUARD=strict to also prove the propose/dedup/commit
+    programs compile once each (the retrace report lands in both)."""
+    quick = "--quick" in sys.argv
+    from uptune_tpu.utils.platform_guard import force_cpu
+    force_cpu(1)
+    import jax  # noqa: F401  (backend must init after force_cpu)
+
+    from uptune_tpu.analysis.trace_guard import guard_from_env
+    with guard_from_env() as guard:
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.workloads import rosenbrock_space
+
+        space = rosenbrock_space(8, -3.0, 3.0)
+        tuner = Tuner(space, None, seed=0)
+
+        def drain(n):
+            done = 0
+            while done < n:
+                for tr in tuner.ask(min_trials=1):
+                    # deterministic dummy QoR stream: spread over [0,
+                    # 1000) so new-bests happen early then rarify, like
+                    # a real tune
+                    tuner.tell(tr, float((tr.gid * 2654435761) % 1000))
+                    done += 1
+            return done
+
+        warm = drain(200)     # compile every arm + commit + observe
+        steady = 500 if quick else 2000
+        t0 = time.perf_counter()
+        steady = drain(steady)
+        dt = time.perf_counter() - t0
+    rate = steady / dt
+    res = tuner.result()
+    result = {
+        "metric": "driver_asks_per_sec",
+        "value": round(rate, 1),
+        "unit": "asks/s",
+        "platform": "cpu",
+        "quick": quick,
+        "trials": steady,
+        "warm_trials": warm,
+        "wall_s": round(dt, 4),
+        "nproc": os.cpu_count(),
+        # driver-plane self-timing over the WHOLE run (TuneResult):
+        # device propose+dedup vs host materialization seconds
+        "t_propose_s": round(res.t_propose, 4),
+        "t_dedup_s": round(res.t_dedup, 4),
+    }
+    if guard.enabled:
+        result["retraces"] = guard.report()
+    # quick runs must not clobber the committed full-run evidence
+    # artifact (same rule as BENCH_TPU.quick.json in main())
+    name = "BENCH_DRIVER.quick.json" if quick else "BENCH_DRIVER.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        name)
+    with open(path, "w") as f:
+        json.dump({**result, "captured_unix": time.time()}, f, indent=1)
+    print(f"bench: driver-plane evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps(result))
+
+
 def main() -> None:
+    if "--driver" in sys.argv:
+        driver_main()
+        return
     quick = "--quick" in sys.argv
     jax, platform = _init_backend(
         cpu_flag="--cpu" in sys.argv,
@@ -209,7 +280,8 @@ def main() -> None:
         # constant seeds by design: a measured bench must replay the
         # same stream run-to-run
         state = eng.init(jax.random.PRNGKey(0))  # ut-lint: disable=R002
-        lowered = jax.jit(lambda s: eng.run(s, steps)).lower(state)
+        # donated EngineState: history/technique buffers update in place
+        lowered = eng.jit_run(steps).lower(state)
         compiled = lowered.compile()
         run = compiled
         state = run(state)                  # warm (already compiled)

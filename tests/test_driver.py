@@ -208,9 +208,9 @@ class TestTuner:
         t = Tuner(space, lambda cfgs: [c["i"] for c in cfgs], seed=0)
         calls = {name: 0 for name in t._propose_jit}
         for name, fn in list(t._propose_jit.items()):
-            def counted(st, k, best, _fn=fn, _n=name):
+            def counted(st, k, best, hs, _fn=fn, _n=name):
                 calls[_n] += 1
-                return _fn(st, k, best)
+                return _fn(st, k, best, hs)
             t._propose_jit[name] = counted
         # run PAST exhaustion: the loop then spins on all-dup proposals
         # until the no-eval streak breaks it

@@ -26,6 +26,26 @@ class TestFusedEngine:
         assert int(state.acqs) == 100 * eng.total_batch
         assert int(state.evals) <= int(state.acqs)
 
+    def test_jit_run_donates_state(self):
+        """jit_run (the bench/drive entry) donates the EngineState:
+        history + technique buffers update in place — the caller must
+        rebind and never reuse the donated input."""
+        space = rosenbrock_space(2, -3.0, 3.0)
+        eng = FusedEngine(space, _rb_obj, history_capacity=1 << 10)
+        s0 = eng.init(jax.random.PRNGKey(0))
+        run = eng.jit_run(5)
+        s1 = run(s0)
+        assert s0.hist.h0.is_deleted()
+        assert s0.best.u.is_deleted()
+        assert np.isfinite(eng.best_qor(s1))
+        # rebound state keeps working across repeated donated calls
+        s2 = run(s1)
+        assert int(s2.acqs) == 10 * eng.total_batch
+        # and donate=False keeps the input alive (debug/compare runs)
+        s3 = eng.init(jax.random.PRNGKey(1))
+        _ = eng.jit_run(2, donate=False)(s3)
+        assert not s3.hist.h0.is_deleted()
+
     def test_trace_monotone(self):
         space = rosenbrock_space(2, -3.0, 3.0)
         eng = FusedEngine(space, _rb_obj)

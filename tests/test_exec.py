@@ -193,6 +193,36 @@ class TestProgramTuner:
                 open(tmp_path / "ut.archive.jsonl")][1:]
         assert any(r["tech"] == "oracle" for r in rows)
 
+    def test_prefetch_overlaps_and_keeps_budget(self, tmp_path):
+        """Async ticket prefetch (default: one pool width of lookahead)
+        must keep the per-trial budget exact and record driver-plane
+        timing; speculative cancels after a new best are bounded by
+        what was queued."""
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=12, seed=11)
+        assert pt.prefetch == pt.parallel  # default depth
+        res = pt.run()
+        assert res.evals <= 12 + pt.parallel
+        assert pt.pool.launched <= 12 + pt.parallel
+        # the tuner measured its own plane: propose happened, and
+        # tickets spent wall-clock waiting on subprocess builds
+        assert res.t_propose > 0.0
+        assert res.t_eval_wait > 0.0
+        assert pt.spec_cancelled >= 0
+        assert 0.0 < pt.pool.utilization() <= 1.0
+        # cancelled speculative trials never reach the archive
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        assert len(rows) == res.evals
+
+    def test_prefetch_zero_is_lockstep(self, tmp_path):
+        """prefetch=0 restores propose-only-when-a-slot-is-free."""
+        pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=8, seed=13,
+                       prefetch=0)
+        res = pt.run()
+        assert res.evals <= 8 + pt.parallel
+        assert pt.spec_cancelled == 0  # nothing speculative to cancel
+        assert res.best_qor < 13 ** 2 + 39 ** 2
+
     def test_params_reuse_skips_analysis(self, tmp_path):
         prog = _write(tmp_path, QUAD_PROG)
         with open(tmp_path / "ut.params.json", "w") as f:

@@ -34,6 +34,15 @@ DEFAULTS: Dict[str, Any] = {
     "online-training": False,
     "technique": None,
     "seed": 0,
+    # async ticket prefetch depth for the program-mode controller
+    # (None = one pool width of lookahead; 0 = lockstep propose-on-free)
+    "prefetch-depth": None,
+    # persistent XLA compilation cache base dir for driver programs
+    # (None = default resolution: UT_COMPILE_CACHE_DIR, else .xla_cache
+    # at the repo root / ~/.cache/uptune_tpu/xla; 'off' disables).  The
+    # controller appends a per-space-signature subdir, so repeated tunes
+    # of the same program skip first-step compiles
+    "compile-cache-dir": None,
 }
 
 settings: Dict[str, Any] = dict(DEFAULTS)

@@ -101,6 +101,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "the declared defaults).  The reference's "
                         "--seed-configuration flag")
     p.add_argument("--seed", type=int, default=None, help="RNG seed")
+    p.add_argument("--prefetch", type=int, default=None, metavar="N",
+                   help="async ticket prefetch depth: keep N trials "
+                        "proposed ahead of free worker slots so device "
+                        "propose+dedup hides behind build wall-clock "
+                        "(default: the parallel factor; 0 = lockstep "
+                        "propose-only-when-a-slot-is-free)")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache base dir "
+                        "(jax_compilation_cache_dir), keyed per space "
+                        "signature so repeated tunes of the same "
+                        "program skip first-step compiles (default: "
+                        ".xla_cache at the repo root / "
+                        "~/.cache/uptune_tpu/xla; pass 'off' to "
+                        "disable)")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
     p.add_argument("--resume", action="store_true",
@@ -395,7 +409,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         technique=technique, seed=args.seed, params_file=args.params,
         resume=args.resume, sandbox=not args.no_sandbox,
         surrogate=surrogate, surrogate_opts=sopts, template=template,
-        seed_configs=seed_cfgs)
+        seed_configs=seed_cfgs, prefetch=args.prefetch,
+        compile_cache_dir=args.compile_cache_dir)
 
     if args.cfg:
         for k in sorted(settings):

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..space.spec import CandBatch, Space
+from ..space.spec import CandBatch, Space, pad_cands
 from ..techniques import base as tbase
 from ..techniques.base import Best, Technique
 from ..techniques.bandit import MetaTechnique
@@ -36,6 +36,36 @@ from .history import History, dup_source
 from .plugins import fire as _fire
 
 Objective = Callable[[List[Dict[str, Any]]], Sequence[float]]
+
+
+def _leaf_keys(tree):
+    """(keys, certain): aliasing keys for every array leaf — the
+    underlying device buffer address, which catches jit input-output
+    forwarding even when it wraps the shared buffer in a new Array
+    object.  certain=False when any leaf's address is unavailable
+    (sharded arrays, jax API drift): the caller must then assume
+    aliasing is possible, because a false negative here would let
+    observe() donate a buffer a sibling in-flight ticket still holds."""
+    keys, certain = set(), True
+    for x in jax.tree_util.tree_leaves(tree):
+        try:
+            keys.add(x.unsafe_buffer_pointer())
+        except Exception:
+            certain = False
+    return keys, certain
+
+
+def _strong(tree):
+    """Strip weak_type from every array leaf (stable input avals).
+    Technique init_state()s built from python scalars (jnp.full(...,
+    jnp.inf)) return WEAK float32 leaves while their observe() outputs
+    are strong — so the arm's propose/observe programs would trace
+    twice, once per weak-type combination (the PR 1 retrace-churn
+    finding).  Normalizing at the init_state boundary keeps every
+    wrapper at exactly one trace, including after restarts."""
+    return jax.tree_util.tree_map(
+        lambda x: (jax.lax.convert_element_type(x, x.dtype)
+                   if getattr(x, "weak_type", False) else x), tree)
 
 
 class StepStats(NamedTuple):
@@ -50,6 +80,13 @@ class StepStats(NamedTuple):
     # history.py insert): nonzero means dedup no longer sees the oldest
     # part of the run
     hist_dropped: int = 0
+    # driver-plane timing for this ticket (seconds): device propose +
+    # dedup dispatch, host-side pending-mask / config materialization,
+    # and wall-clock from ticket open to finalize (the window external
+    # evaluation has to hide device work in)
+    t_propose: float = 0.0
+    t_dedup: float = 0.0
+    t_eval_wait: float = 0.0
 
 
 class Trial:
@@ -82,7 +119,8 @@ class _Ticket:
 
     __slots__ = ("arm", "arm_name", "tstate", "cands", "hashes", "known",
                  "src", "novel_np", "injected", "pruned", "trials",
-                 "remaining", "u_np", "perms_np", "gen", "credit_virtual")
+                 "remaining", "u_np", "perms_np", "gen", "credit_virtual",
+                 "packed", "t_propose", "t_dedup", "t_open")
 
     def __init__(self, arm, arm_name, tstate, cands, hashes, known, src,
                  novel_np, injected, pruned, gen=0, credit_virtual=False):
@@ -104,6 +142,10 @@ class _Ticket:
         self.remaining = 0
         self.u_np = None
         self.perms_np = None
+        self.packed = None        # [B] uint64 packed hashes (host)
+        self.t_propose = 0.0      # s in the propose+dedup device call
+        self.t_dedup = 0.0        # s in host-side mask + materialization
+        self.t_open = 0.0         # perf_counter() when the ticket opened
         # member-state generation at open time: a restart bumps the
         # member's generation, and stale tickets (opened before the
         # restart) must not write observe(tk.tstate) back over the
@@ -117,6 +159,13 @@ class TuneResult(NamedTuple):
     evals: int
     steps: int
     trace: List[float]       # best-so-far (user orientation) after each eval
+    # cumulative driver-plane timing (seconds; see StepStats): how much
+    # device/host proposal work the run did, and how much wall-clock
+    # tickets spent waiting on external evaluation (the budget async
+    # prefetch hides the first two behind)
+    t_propose: float = 0.0
+    t_dedup: float = 0.0
+    t_eval_wait: float = 0.0
 
 
 class Tuner:
@@ -178,6 +227,7 @@ class Tuner:
         self.trace: List[float] = []
         self._zero_novel_streak = 0
         self._cap_warned = False
+        self._last_dropped = 0
         self.pruned_total = 0
         self._surr_tick = 0   # acquisition counter for propose_every
         # arms whose last proposal was entirely duplicates, keyed by the
@@ -255,13 +305,47 @@ class Tuner:
             t.name: t for t in self.members}
         # bumped on each RecyclingMeta restart; see _Ticket.gen
         self._tgen: Dict[str, int] = {t.name: 0 for t in self.members}
+        # common dedup/commit batch size: every arm's proposal is padded
+        # to this bucket inside its propose program, so `_commit` (and
+        # the standalone `_dedup`) see ONE input aval across arms and
+        # trace once instead of once per distinct arm batch (the PR 1
+        # trace-guard finding: 3 traces/tune from DE=30 / GM=32 / NM=D+1
+        # shapes).  inject() pads host-side to a multiple of the same
+        # bucket.
+        self._bucket = max(t.natural_batch(space) for t in self.members)
+        sp, hist = self.space, self.history
+
+        def _propose_dedup(t, st, k, best, hist_state):
+            """One fused device program per arm: propose the arm's
+            natural batch, pad to the bucket, hash + dedup vs history +
+            in-batch.  Replaces two host dispatches (propose, _dedup)
+            with one."""
+            st2, c = t.propose(sp, st, k, best)
+            cp = pad_cands(c, self._bucket)
+            hashes = sp.hash_batch(cp)
+            found, known = hist.contains(hist_state, hashes)
+            src = dup_source(hashes)
+            novel = (src == jnp.arange(hashes.shape[0])) & ~found
+            return st2, cp, hashes, known, src, novel
+
         for t in self.members:
             self.key, k = jax.random.split(self.key)
-            self._tstates[t.name] = t.init_state(space, k)
+            self._tstates[t.name] = _strong(t.init_state(space, k))
             self._propose_jit[t.name] = jax.jit(
-                lambda st, k, best, _t=t: _t.propose(space, st, k, best))
-            self._observe_jit[t.name] = jax.jit(
-                lambda st, c, q, best, _t=t: _t.observe(space, st, c, q, best))
+                lambda st, k, best, hs, _t=t:
+                _propose_dedup(_t, st, k, best, hs))
+            # observe consumes the ticket's padded batch, slicing back
+            # to the arm's own proposal rows; the technique state is
+            # DONATED — tk.tstate must never be reused after this call.
+            # Exception: an arm whose propose() FORWARDS state buffers
+            # unchanged is detected on its first pull and routed
+            # through a non-donating wrapper (_finalize) — with several
+            # of its tickets in flight they alias one buffer, and
+            # donating it under ticket A would delete ticket B's state
+            self._observe_jit[t.name] = self._make_observe(t, True)
+        self._observe_nodonate: Dict[str, Any] = {}
+        self._arm_forwards: set = set()
+        self._fwd_checked: set = set()
 
         # surrogate arbitration='bandit': the proposal plane becomes a
         # credit-earning VIRTUAL ARM of the AUC bandit instead of firing
@@ -279,8 +363,6 @@ class Tuner:
                     "root technique and propose_batch > 0; falling back "
                     "to the scheduled proposal plane", UserWarning)
 
-        sp, hist = self.space, self.history
-
         @jax.jit
         def _dedup(hist_state, cands: CandBatch):
             hashes = sp.hash_batch(cands)
@@ -290,7 +372,12 @@ class Tuner:
             novel = first & ~found
             return hashes, found, known, src, novel
 
-        @jax.jit
+        # history and best are DONATED: the [cap] history buffers are
+        # updated in place instead of copied every step (the old
+        # _commit copied the full capacity-sized state per ticket), and
+        # the pre-commit HistState/Best objects are dead after the call
+        # — the driver immediately rebinds self.hist_state/self.best
+        # and nothing else may hold them (docs/PERF.md invariants)
         def _commit(hist_state, best, hashes, cands: CandBatch, qor,
                     newly):
             hist_state = hist.insert(hist_state, hashes, qor, newly)
@@ -298,7 +385,12 @@ class Tuner:
             return hist_state, best
 
         self._dedup = _dedup
-        self._commit = _commit
+        self._commit = jax.jit(_commit, donate_argnums=(0, 1))
+        # driver-plane timing accumulators (seconds; surfaced via
+        # StepStats per ticket and TuneResult totals)
+        self.t_propose_total = 0.0
+        self.t_dedup_total = 0.0
+        self.t_eval_wait_total = 0.0
 
         if resume and archive and os.path.exists(archive):
             self._resume(archive)
@@ -315,6 +407,17 @@ class Tuner:
             self._archive_f.flush()
 
     # ------------------------------------------------------------------
+    def _make_observe(self, t: Technique, donate: bool):
+        """The per-arm observe program: slice the padded ticket batch
+        back to the arm's own rows, feed the measured QoR.  One factory
+        for both the donating default and the non-donating variant
+        forwarding-state arms fall back to."""
+        sp, nb = self.space, t.natural_batch(self.space)
+        return jax.jit(
+            lambda st, c, q, best, _t=t, _b=nb:
+            _t.observe(sp, st, c[:_b], q[:_b], best),
+            donate_argnums=(0,) if donate else ())
+
     def _space_sig(self) -> List[str]:
         """Ordered structural signature of the space: spec dataclass reprs
         carry name, kind, bounds, options/items — any change invalidates
@@ -444,14 +547,17 @@ class Tuner:
         return (hs[:, 0] << np.uint64(32)) | hs[:, 1]
 
     def _mask_pending(self, hashes, novel):
-        """Drop candidates whose hash is already out for evaluation."""
+        """Drop candidates whose hash is already out for evaluation.
+        Returns (novel mask, novel count, packed host hashes) — packed
+        flows into the ticket so the batch is pulled host-side exactly
+        once per acquisition."""
         novel_np = np.array(novel)  # writable copy: filters mutate it
+        packed = self._pack_hashes(hashes)
         if self._pending:
-            packed = self._pack_hashes(hashes)
             pend = np.fromiter(self._pending, np.uint64,
                                len(self._pending))
             novel_np = novel_np & ~np.isin(packed, pend)
-        return novel_np, int(novel_np.sum())
+        return novel_np, int(novel_np.sum()), packed
 
     def _surrogate_ticket(self, credit: bool) -> Optional[_Ticket]:
         """Try to pull the surrogate proposal plane once: EI-maximizing
@@ -521,13 +627,13 @@ class Tuner:
         return self._surrogate_ticket(credit=False)
 
     def _dedup_masked(self, cands: CandBatch):
-        """(hashes, known, src, novel_np): dedup vs history + in-batch,
-        then mask hashes already out for evaluation."""
+        """(hashes, known, src, novel_np, packed): dedup vs history +
+        in-batch, then mask hashes already out for evaluation."""
         hashes, found, known, src, novel = self._dedup(
             self.hist_state, cands)
-        novel_np, _ = self._mask_pending(hashes, novel)
+        novel_np, _, packed = self._mask_pending(hashes, novel)
         return (hashes, np.asarray(known, np.float32).copy(),
-                np.asarray(src), novel_np)
+                np.asarray(src), novel_np, packed)
 
     def _open_injected_ticket(self, cands: CandBatch, source: str,
                               _pre=None, credit_virtual=False) -> _Ticket:
@@ -536,11 +642,12 @@ class Tuner:
         Injected tickets never touch technique states; they skip bandit
         credit too unless credit_virtual (the bandit-arbitrated
         surrogate arm)."""
-        hashes, known, src, novel_np = (_pre if _pre is not None
-                                        else self._dedup_masked(cands))
+        hashes, known, src, novel_np, packed = (
+            _pre if _pre is not None else self._dedup_masked(cands))
         tk = _Ticket(None, source, None, cands, hashes, known, src,
                      novel_np, injected=True, pruned=0,
                      credit_virtual=credit_virtual)
+        tk.packed = packed
         self._open_ticket(tk)
         return tk
 
@@ -583,6 +690,8 @@ class Tuner:
             order.append(self.members[0])
 
         chosen = None
+        t_prop = 0.0
+        t_host0 = time.perf_counter()
         for t in order:
             if isinstance(t, str):  # virtual arm: the surrogate plane
                 stk = self._surrogate_ticket(credit=True)
@@ -590,21 +699,32 @@ class Tuner:
                     return stk
                 continue  # can't pull (not fitted / saturated): next arm
             self.key, k = jax.random.split(self.key)
-            tstate, cands = self._propose_jit[t.name](
-                self._tstates[t.name], k, self.best)
-            hashes, found, known, src, novel = self._dedup(
-                self.hist_state, cands)
-            novel_np, n_novel = self._mask_pending(hashes, novel)
+            # ONE fused device program: propose + pad + hash + dedup
+            p0 = time.perf_counter()
+            tstate, cands, hashes, known, src, novel = self._propose_jit[
+                t.name](self._tstates[t.name], k, self.best,
+                        self.hist_state)
+            t_prop += time.perf_counter() - p0
+            if t.name not in self._fwd_checked:
+                self._fwd_checked.add(t.name)
+                held, ok_in = _leaf_keys(self._tstates[t.name])
+                out, ok_out = _leaf_keys(tstate)
+                if (held & out) or not (ok_in and ok_out):
+                    # proven aliasing — or unprovable: donation is a
+                    # perf nicety, never worth a deleted-buffer crash
+                    self._arm_forwards.add(t.name)
+            novel_np, n_novel, packed = self._mask_pending(hashes, novel)
             if n_novel > 0:
                 self._arm_dry.pop(t.name, None)
             else:
                 self._arm_dry[t.name] = self._acq_count
             if n_novel > 0 or chosen is None:
                 chosen = (t, tstate, cands, hashes, known, src, novel_np,
-                          n_novel)
+                          n_novel, packed)
             if n_novel > 0:
                 break
-        t, tstate, cands, hashes, known, src, novel_np, n_novel = chosen
+        (t, tstate, cands, hashes, known, src, novel_np, n_novel,
+         packed) = chosen
 
         injected = False
         if n_novel == 0:
@@ -617,10 +737,13 @@ class Tuner:
                 # flow into the arm's observe() or bandit credit.
                 injected = True
                 self.key, k = jax.random.split(self.key)
+                p0 = time.perf_counter()
                 cands = self.space.random(k, cands.batch)
                 hashes, found, known, src, novel = self._dedup(
                     self.hist_state, cands)
-                novel_np, n_novel = self._mask_pending(hashes, novel)
+                t_prop += time.perf_counter() - p0
+                novel_np, n_novel, packed = self._mask_pending(hashes,
+                                                               novel)
         else:
             self._zero_novel_streak = 0
 
@@ -642,15 +765,26 @@ class Tuner:
                      np.asarray(known, np.float32).copy(), np.asarray(src),
                      novel_np, injected, pruned,
                      gen=self._tgen.get(t.name, 0))
+        tk.packed = packed
+        tk.t_propose = t_prop
         self._open_ticket(tk)
+        tk.t_dedup = time.perf_counter() - t_host0 - t_prop
         return tk
 
     def _open_ticket(self, tk: _Ticket) -> None:
         """Materialize trials for a ticket's novel rows (after the
         optional ut.rule config filter) and register them pending."""
+        tk.t_open = time.perf_counter()
+        if tk.packed is None:  # all acquisition paths pre-pack
+            tk.packed = self._pack_hashes(tk.hashes)
         if tk.novel_np.any():
             idx = np.nonzero(tk.novel_np)[0]
-            sub = tk.cands[jnp.asarray(idx)]
+            # one device->host transfer of the whole batch, then plain
+            # numpy row selection: the old per-ticket device gather was
+            # two extra dispatches on the ask() critical path
+            u_all = np.asarray(tk.cands.u)
+            perms_all = [np.asarray(p) for p in tk.cands.perms]
+            sub = CandBatch(u_all[idx], tuple(p[idx] for p in perms_all))
             cfgs = self.space.to_configs(sub)
             if self.config_filter is not None:
                 keep = np.asarray([bool(self.config_filter(c))
@@ -660,16 +794,15 @@ class Tuner:
                     tk.novel_np[idx[~keep]] = False
                     idx = idx[keep]
                     cfgs = [c for c, k in zip(cfgs, keep) if k]
-                    sub = (tk.cands[jnp.asarray(idx)] if len(idx)
-                           else None)
+                    sub = CandBatch(u_all[idx],
+                                    tuple(p[idx] for p in perms_all))
             if len(idx):
                 tk.u_np = np.asarray(sub.u)
                 tk.perms_np = [np.asarray(p) for p in sub.perms]
-                packed = self._pack_hashes(tk.hashes)
                 for j, (row, cfg) in enumerate(zip(idx, cfgs)):
                     tk.trials.append(Trial(self.gid, cfg, tk, j, int(row)))
                     self.gid += 1
-                    self._pending.add(int(packed[row]))
+                    self._pending.add(int(tk.packed[row]))
         tk.remaining = len(tk.trials)
         st = self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])
         st[0] += 1
@@ -682,7 +815,17 @@ class Tuner:
         'seed' rows, api.py:341-363).  Injected tickets never touch
         technique states or bandit credit; resolve the returned trials
         via tell()."""
-        cands = self.space.from_configs(list(cfgs))
+        cfgs = list(cfgs)
+        # pad to a multiple of the dedup bucket by repeating the first
+        # config: padding rows are exact in-batch duplicates (never
+        # novel, never trials), and the standalone _dedup/_commit
+        # programs keep seeing the same input aval as the arm tickets
+        # instead of tracing once per injected batch size
+        n = len(cfgs)
+        target = -(-n // self._bucket) * self._bucket
+        if n and n < target:
+            cfgs = cfgs + [cfgs[0]] * (target - n)
+        cands = self.space.from_configs(cfgs)
         tk = self._open_injected_ticket(cands, source)
         if not tk.trials:
             self._finalize(tk)  # all dups: serve + commit immediately
@@ -760,7 +903,7 @@ class Tuner:
         """Commit a completed ticket: history insert, best update,
         archive rows, technique observe + bandit credit."""
         qor_np = tk.known  # history dups served their recorded result
-        packed = self._pack_hashes(tk.hashes)
+        packed = tk.packed
         live = [tr for tr in tk.trials if not tr.cancelled]
         for tr in tk.trials:
             self._pending.discard(int(packed[tr.row]))
@@ -769,20 +912,35 @@ class Tuner:
             else:
                 qor_np[tr.row] = tr.qor
         evaluated = len(live)
+        # a ticket whose trials were ALL withdrawn (speculative prefetch
+        # invalidated by a new best, or the run limit arriving first)
+        # was never evaluated: no observe, no bandit credit — the pull
+        # outcome is unknown, not negative.  A ZERO-trial ticket (every
+        # row a served duplicate) is different: its dup-serving credit
+        # event is the load-bearing negative feedback that lets the
+        # bandit starve a saturated arm.
+        withdrawn = bool(tk.trials) and not live
         if evaluated and self.surrogate is not None:
             idx = jnp.asarray([tr.row for tr in live])
             self.surrogate.observe(
                 np.asarray(self.space.features(tk.cands[idx])),
                 qor_np[np.asarray(idx)])
             self.surrogate.maybe_refit()
-        # in-batch duplicates copy their source row's result
-        qor = jnp.asarray(qor_np[tk.src])
 
         prev = float(self.best.qor)
-        self.hist_state, self.best = self._commit(
-            self.hist_state, self.best, tk.hashes, tk.cands, qor,
-            jnp.asarray(tk.novel_np))
-        new = float(self.best.qor)
+        qor = None
+        if evaluated or tk.novel_np.any():
+            # in-batch duplicates copy their source row's result
+            qor = jnp.asarray(qor_np[tk.src])
+            self.hist_state, self.best = self._commit(
+                self.hist_state, self.best, tk.hashes, tk.cands, qor,
+                jnp.asarray(tk.novel_np))
+            self._last_dropped = int(self.hist_state.dropped)
+            new = float(self.best.qor)
+        else:
+            # nothing evaluated and nothing novel: the commit would be
+            # a pure no-op — skip the device dispatch entirely
+            new = prev
         was_new_best = new < prev
 
         running = prev
@@ -796,10 +954,26 @@ class Tuner:
             self.trace.append(self.sign * running)
         self.evals += evaluated
 
-        if not tk.injected:
+        if not tk.injected and not withdrawn:
             if tk.gen == self._tgen.get(tk.arm.name, 0):
-                self._tstates[tk.arm.name] = self._observe_jit[
-                    tk.arm.name](tk.tstate, tk.cands, qor, self.best)
+                if qor is None:
+                    qor = jnp.asarray(qor_np[tk.src])
+                # tk.tstate is DONATED into observe: a ticket's propose
+                # snapshot is dead after its own observe call (unless
+                # the arm forwards state through propose — then several
+                # in-flight tickets alias one buffer and donation would
+                # delete a sibling's state)
+                nm = tk.arm.name
+                if nm in self._arm_forwards:
+                    fn = self._observe_nodonate.get(nm)
+                    if fn is None:
+                        fn = self._make_observe(
+                            self._member_by_name[nm], False)
+                        self._observe_nodonate[nm] = fn
+                else:
+                    fn = self._observe_jit[nm]
+                self._tstates[nm] = fn(tk.tstate, tk.cands, qor,
+                                       self.best)
             # else: the member was restarted while this ticket was in
             # flight — observing would write the pre-restart snapshot
             # back over the fresh state, silently undoing the restart
@@ -812,15 +986,17 @@ class Tuner:
                     t = self._member_by_name.get(nm)
                     if t is not None:
                         self.key, k = jax.random.split(self.key)
-                        self._tstates[nm] = t.init_state(self.space, k)
+                        self._tstates[nm] = _strong(
+                            t.init_state(self.space, k))
                         self._tgen[nm] = self._tgen.get(nm, 0) + 1
-        elif tk.credit_virtual and isinstance(self.root, MetaTechnique):
+        elif tk.credit_virtual and isinstance(self.root, MetaTechnique) \
+                and not withdrawn:
             # bandit-arbitrated surrogate pull: no technique state to
             # observe, but the outcome is the virtual arm's AUC event
             self._credit(tk.arm_name, was_new_best, live, new)
         if was_new_best:
             self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])[2] += 1
-        dropped = int(self.hist_state.dropped)
+        dropped = self._last_dropped
         if dropped and not self._cap_warned:
             self._cap_warned = True
             import warnings
@@ -831,9 +1007,14 @@ class Tuner:
                 f"running drop count is in StepStats.hist_dropped")
         self.steps += 1
         self._flush_archive()
+        t_wait = time.perf_counter() - tk.t_open if tk.t_open else 0.0
+        self.t_propose_total += tk.t_propose
+        self.t_dedup_total += tk.t_dedup
+        self.t_eval_wait_total += t_wait
         stats = StepStats(self.steps, tk.arm_name, tk.cands.batch,
                           evaluated, self.sign * new, was_new_best,
-                          tk.pruned, dropped)
+                          tk.pruned, dropped, tk.t_propose, tk.t_dedup,
+                          t_wait)
         if self.hooks:
             if was_new_best:
                 res = self.result()
@@ -1011,7 +1192,8 @@ class Tuner:
         if math.isfinite(q):
             cfg = self.space.to_configs(self.best.as_batch(1))[0]
         return TuneResult(cfg, self.sign * q, self.evals, self.steps,
-                          list(self.trace))
+                          list(self.trace), self.t_propose_total,
+                          self.t_dedup_total, self.t_eval_wait_total)
 
     def best_config(self) -> Dict[str, Any]:
         return self.result().best_config

@@ -163,23 +163,31 @@ class History:
 
         # stable two-run merge: old rows before new rows on equal h0
         # (keeps equal-h0 runs contiguous; h1 order within a run is
-        # unspecified by the invariant)
-        pos_hist = (jnp.arange(cap, dtype=jnp.int32)
-                    + jnp.searchsorted(h0s, h0h, side="left"
-                                       ).astype(jnp.int32))
+        # unspecified by the invariant).  Formulated as GATHERS off one
+        # tiny b-row scatter: the merge-path positions of the B new rows
+        # are marked in a boolean lane, and every output slot then pulls
+        # its row via cumsum-derived indices.  The previous formulation
+        # scattered all 4 value arrays at full width twice each — XLA
+        # lowers big scatters to element loops (measured 25 ms/commit at
+        # cap=2^16 on 1 CPU core, ~1 ms as gathers), and gathers also
+        # vectorize better on TPU.
         pos_new = (jnp.arange(b, dtype=jnp.int32)
                    + jnp.searchsorted(h0h, h0s, side="right"
                                       ).astype(jnp.int32))
+        is_new = jnp.zeros((cap + b,), bool).at[pos_new].set(True)
+        idx_new = jnp.cumsum(is_new.astype(jnp.int32)) - 1
+        idx_hist = jnp.arange(cap + b, dtype=jnp.int32) - idx_new - 1
+        idx_new = jnp.clip(idx_new, 0, b - 1)
+        idx_hist = jnp.clip(idx_hist, 0, cap - 1)
 
-        def scat(hist_v, new_v, fill, dtype):
-            out = jnp.full((cap + b,), fill, dtype)
-            out = out.at[pos_hist].set(hist_v, mode="drop")
-            return out.at[pos_new].set(new_v, mode="drop")
+        def mrg(hist_v, new_v):
+            return jnp.where(is_new, new_v[idx_new],
+                             hist_v[idx_hist])[:cap]
 
-        h0m = scat(h0h, h0s, _SENTINEL, jnp.uint32)[:cap]
-        h1m = scat(h1h, h1s, _SENTINEL, jnp.uint32)[:cap]
-        qm = scat(qh, qs, jnp.inf, jnp.float32)[:cap]
-        am = scat(ah, ags, -1, jnp.int32)[:cap]
+        h0m = mrg(h0h, h0s)
+        h1m = mrg(h1h, h1s)
+        qm = mrg(qh, qs)
+        am = mrg(ah, ags)
 
         n = jnp.minimum(total, cap)
         return HistState(h0m, h1m, qm, n, am, st.step + 1,

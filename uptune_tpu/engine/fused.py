@@ -173,6 +173,19 @@ class FusedEngine:
         out, _ = jax.lax.scan(body, state, None, length=n_steps)
         return out
 
+    def jit_run(self, n_steps: int, eval_fn=None, exchange=None,
+                donate: bool = True):
+        """jax.jit-wrapped run(): the preferred entry for repeated
+        driving.  With donate=True (default) the EngineState argument is
+        DONATED — the multi-MB history buffers are updated in place
+        instead of copied on every call, and the caller must rebind
+        (`state = run(state)`) and never touch the donated input again.
+        Returns the jitted callable (supports .lower(state) for AOT
+        compile + cost analysis, as bench.py uses)."""
+        def _run(s):
+            return self.run(s, n_steps, eval_fn, exchange)
+        return jax.jit(_run, donate_argnums=(0,) if donate else ())
+
     def run_traced(self, state: EngineState,
                    n_steps: int) -> Tuple[EngineState, jax.Array]:
         """Like run() but also returns the best-so-far trace [n_steps]

@@ -62,7 +62,9 @@ class ProgramTuner:
                  sandbox: bool = True,
                  status_interval: Optional[int] = None,
                  template=None, hooks=None,
-                 seed_configs: Optional[List[Dict]] = None):
+                 seed_configs: Optional[List[Dict]] = None,
+                 prefetch: Optional[int] = None,
+                 compile_cache_dir: Optional[str] = None):
         # seed_configs: known-good configurations injected as 'seed'
         # trials at startup (the reference's --seed-configuration file
         # loading, opentuner/search/driver.py:37-42) — warm-starts
@@ -151,6 +153,22 @@ class ProgramTuner:
         self.use_sandbox = sandbox
         self.status_interval = (status_interval if status_interval
                                 is not None else max(1, self.parallel))
+        # async ticket prefetch: keep `prefetch` trials proposed AHEAD
+        # of free worker slots, so the device propose+dedup+config
+        # materialization runs while every slot is still busy building
+        # and a freed slot is refilled instantly (0 = the old lockstep
+        # behavior: propose only when a slot is already free).  Default
+        # is the pool width — one build wave of lookahead.
+        pf = (prefetch if prefetch is not None
+              else settings["prefetch-depth"])
+        self.prefetch = int(pf if pf is not None else self.parallel)
+        self.compile_cache_dir = (
+            compile_cache_dir if compile_cache_dir is not None
+            else settings["compile-cache-dir"])
+        # observability: speculative trials withdrawn after a tell()
+        # landed a new best (their tickets were proposed around the
+        # stale incumbent)
+        self.spec_cancelled = 0
 
         self.params: Optional[List[List[Dict[str, Any]]]] = None
         self.default_qor: Optional[float] = None
@@ -224,6 +242,23 @@ class ProgramTuner:
                 pass
 
     # ------------------------------------------------------------------
+    def _enable_compile_cache(self, space) -> None:
+        """Persistent XLA compilation cache for the driver's device
+        programs, keyed by the space signature: repeated tunes of the
+        same program load their propose/dedup/commit executables from
+        disk instead of paying first-step compiles (~seconds each).
+        Set the base dir via ut.config({'compile-cache-dir': ...}) /
+        `ut --compile-cache-dir`; the literal value 'off' disables."""
+        base = self.compile_cache_dir
+        if isinstance(base, str) and base.lower() in ("off", "none"):
+            return
+        import hashlib
+
+        from ..utils.platform_guard import enable_compile_cache
+        sig = hashlib.sha1("\n".join(
+            repr(s) for s in space.specs).encode()).hexdigest()[:16]
+        enable_compile_cache(base, subdir=sig)
+
     def _make_tuner(self, space) -> Tuner:
         filt = (REGISTRY.check_config if REGISTRY.rules else None)
         return Tuner(space, None, technique=self.technique,
@@ -254,6 +289,33 @@ class ProgramTuner:
                  "replaced=%d", res.evals, res.best_qor, lw,
                  self.pool.busy_count, self.pool.replaced)
 
+    @staticmethod
+    def _cancel_speculative(queue, tuner: Tuner) -> int:
+        """Withdraw queued-but-unlaunched trials whose ticket came from
+        a technique arm (or the bandit-arbitrated surrogate plane):
+        they were proposed around the now-stale incumbent.  cancel()
+        guarantees no archive row, no history insert, and — when a
+        ticket loses ALL its trials — no observe() and no bandit credit
+        (driver._finalize `withdrawn`), so a cancelled pull is an
+        unknown outcome, not a penalty.  Externally-provided trials
+        (seed configs, @ut.model proposals, random saturation top-ups)
+        are kept: their value does not depend on the incumbent."""
+        kept, n = [], 0
+        while queue:
+            tr = queue.popleft()
+            tk = tr.ticket
+            # injected covers seed/model AND the random saturation
+            # top-up (arm set, injected=True) — all incumbent-agnostic;
+            # the bandit-arbitrated surrogate pull (credit_virtual) is
+            # injected too but IS proposed around the incumbent
+            if (not tk.injected) or tk.credit_virtual:
+                tuner.cancel(tr)
+                n += 1
+            else:
+                kept.append(tr)
+        queue.extend(kept)
+        return n
+
     def _host_proposals(self, space) -> List[Trial]:
         """Ask @ut.model proposal sources for one config each."""
         trials: List[Trial] = []
@@ -281,6 +343,7 @@ class ProgramTuner:
                       else self.timeout)
         records = self.params[self.stage]
         space = space_from_params(records)
+        self._enable_compile_cache(space)
         self.tuner = tuner = self._make_tuner(space)
         # the CLI drives ask/tell (not Tuner.run), so the run-budget
         # surrogate rule is applied here where the limit is known
@@ -340,20 +403,30 @@ class ProgramTuner:
                                      if self.host_tag else "")) as pool:
             self.pool = pool
             while True:
-                # gate on told (per-trial), not evals (per-ticket): a
-                # wide in-flight ticket must still count against the
-                # budget, or a --test-limit 25 run launches 50+ trials
+                # 1. refill freed slots INSTANTLY from the prefetched
+                # queue — no device work on this path.  Gate on told
+                # (per-trial), not evals (per-ticket): a wide in-flight
+                # ticket must still count against the budget, or a
+                # --test-limit 25 run launches 50+ trials
+                while queue and pool.n_free and \
+                        tuner.told + pool.busy_count < limit:
+                    pool.submit(queue.popleft(), stage=self.stage)
+                # 2. speculative prefetch: top the queue back up to
+                # `prefetch` trials while every slot is busy building,
+                # so the propose+dedup device programs and config
+                # materialization hide entirely behind build wall-clock
                 outstanding = pool.busy_count + len(queue)
+                depth = max(self.prefetch, pool.n_free)
                 if (tuner.told + outstanding < limit
-                        and len(queue) < len(pool.free_slots())
+                        and len(queue) < depth
                         and dry_asks < 8):
-                    want = len(pool.free_slots()) - len(queue)
+                    want = min(depth - len(queue),
+                               limit - tuner.told - outstanding)
                     asked = tuner.ask(min_trials=want)
                     queue.extend(asked)
                     dry_asks = 0 if asked else dry_asks + 1
-                while queue and pool.free_slots() and \
-                        tuner.told + pool.busy_count < limit:
-                    pool.submit(queue.popleft(), stage=self.stage)
+                    if asked and pool.n_free:
+                        continue  # launch the fresh trials before polling
                 if pool.busy_count == 0:
                     if tuner.told >= limit:
                         break
@@ -366,6 +439,16 @@ class ProgramTuner:
                     stats = tuner.tell(trial, qor, dur)
                     if qor is not None:
                         self._host_history.append((trial.config, qor))
+                    if stats is not None and stats.was_new_best \
+                            and self.prefetch:
+                        # a new best invalidates speculative technique
+                        # tickets proposed around the stale incumbent:
+                        # withdraw the un-launched ones so the refill
+                        # proposes against the new best instead
+                        # (prefetch=0 keeps the legacy fire-everything
+                        # behavior)
+                        self.spec_cancelled += self._cancel_speculative(
+                            queue, tuner)
                     self._maybe_new_best(stats)
                     self._status(qor)
                 if wall_limit and time.time() - t0 > wall_limit:
@@ -377,6 +460,15 @@ class ProgramTuner:
             # rows, no failure penalty — the limit simply arrived first
             while queue:
                 tuner.cancel(queue.popleft())
+            # the async-pipeline scoreboard (docs/PERF.md): slot-seconds
+            # spent building vs driver overhead the prefetch failed to
+            # hide behind them
+            log.info(
+                "[ut] pool utilization=%.2f (driver t_propose=%.2fs "
+                "t_dedup=%.2fs behind t_eval_wait=%.1fs; speculative "
+                "cancels=%d)", pool.utilization(),
+                tuner.t_propose_total, tuner.t_dedup_total,
+                tuner.t_eval_wait_total, self.spec_cancelled)
         res = tuner.result()
         if res.best_config:
             write_best(res.best_config, res.best_qor,

@@ -101,6 +101,8 @@ class WorkerPool:
         self.temp_root = os.path.join(self.work_dir, "ut.temp")
         self.replaced = 0          # dead-worker replacements performed
         self.launched = 0
+        self.busy_s = 0.0          # summed per-trial wall time (reaped)
+        self._t_started = time.time()
         self._slots: List[_Slot] = []
 
     # ------------------------------------------------------------------
@@ -108,6 +110,7 @@ class WorkerPool:
         os.makedirs(self.temp_root, exist_ok=True)
         self._slots = [
             _Slot(i, self._build_sandbox(i)) for i in range(self.n_workers)]
+        self._t_started = time.time()
         return self
 
     def _build_sandbox(self, index: int) -> str:
@@ -146,6 +149,18 @@ class WorkerPool:
     @property
     def busy_count(self) -> int:
         return sum(1 for s in self._slots if s.busy)
+
+    @property
+    def n_free(self) -> int:
+        return sum(1 for s in self._slots if not s.busy)
+
+    def utilization(self) -> float:
+        """Fraction of slot-seconds spent running trials since start()
+        (reaped trials only).  1.0 = every slot always building; the gap
+        to 1.0 is dispatch overhead the driver failed to hide — the
+        number async prefetch exists to push up."""
+        wall = max(time.time() - self._t_started, 1e-9)
+        return min(1.0, self.busy_s / (wall * max(1, self.n_workers)))
 
     def submit(self, trial, stage: int = 0,
                extra_env: Optional[Dict[str, str]] = None) -> int:
@@ -209,6 +224,7 @@ class WorkerPool:
     def _reap(self, slot: _Slot, *, killed: bool) -> Tuple[Any, Optional[
             float], float, Dict[str, Any]]:
         dur = time.time() - slot.t0
+        self.busy_s += dur
         rc = slot.proc.returncode
         for f in (slot.log_f, slot.err_f):
             if f is not None:

@@ -6,4 +6,4 @@ from .params import (  # noqa: F401
     SelectorParam, ArrayParam, BoolArrayParam, IntArrayParam,
     FloatArrayParam, infer_param,
 )
-from .spec import CandBatch, Space, concat_cands  # noqa: F401
+from .spec import CandBatch, Space, concat_cands, pad_cands  # noqa: F401

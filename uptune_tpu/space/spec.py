@@ -58,6 +58,27 @@ def concat_cands(cands: Sequence[CandBatch]) -> CandBatch:
               for ps in zip(*[c.perms for c in cands])))
 
 
+def pad_cands(cands: CandBatch, n: int) -> CandBatch:
+    """Pad the batch axis to `n` rows by repeating row 0 (jittable,
+    static shapes).  The driver pads every arm's proposal to one common
+    bucket size so its dedup/commit programs see ONE input aval and
+    trace once instead of once per arm batch shape; a padding row is an
+    exact in-batch duplicate of row 0, so `dup_source`/`unique_mask`
+    classify it as non-novel and it can never become a trial or enter
+    the history."""
+    b = cands.batch
+    if b >= n:
+        return cands
+    pad = n - b
+    return CandBatch(
+        jnp.concatenate(
+            [cands.u, jnp.broadcast_to(cands.u[:1], (pad,) +
+                                       cands.u.shape[1:])], axis=0),
+        tuple(jnp.concatenate(
+            [p, jnp.broadcast_to(p[:1], (pad,) + p.shape[1:])], axis=0)
+            for p in cands.perms))
+
+
 class Space:
     """Static (host-side, hashable-by-id) description of a search space plus
     the numpy/JAX constant tables used by the device codecs.
