@@ -22,4 +22,24 @@ args=(uptune_tpu/ bench.py scripts/ --format text)
 if [ -f scripts/lint_baseline.json ]; then
     args+=(--baseline scripts/lint_baseline.json)
 fi
-exec "${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"
+"${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"
+
+# uptune_tpu/store/ must stay SUPPRESSION-FREE on top of clean: cache-
+# correctness code (what decides whether a build is skipped) gets no
+# '# ut-lint: disable' escape hatch and no baseline (ISSUE 4 satellite)
+"${PYTHON:-python3}" - <<'EOF'
+import json, subprocess, sys
+r = subprocess.run(
+    [sys.executable, "-m", "uptune_tpu.analysis", "uptune_tpu/store",
+     "--format", "json", "--show-suppressed"],
+    capture_output=True, text=True)
+doc = json.loads(r.stdout)
+if doc["findings"]:
+    print("ut-lint: uptune_tpu/store/ must be finding- AND "
+          "suppression-free:", file=sys.stderr)
+    for f in doc["findings"]:
+        print(f"  {f['path']}:{f['line']} {f['rule']} "
+              f"(suppressed={f.get('suppressed', False)})",
+              file=sys.stderr)
+    sys.exit(1)
+EOF

@@ -619,6 +619,22 @@ class TestTraceGuard:
 
 
 # ------------------------------------------------------- repo gate
+def test_store_package_suppression_free():
+    """The results-store package (what decides whether a build is
+    SKIPPED — cache correctness) must be finding- AND suppression-free:
+    no '# ut-lint: disable' escape hatch, no baseline.  lint.sh
+    enforces the same in the pre-commit gate."""
+    r = subprocess.run(
+        [sys.executable, "-m", "uptune_tpu.analysis",
+         os.path.join(REPO, "uptune_tpu", "store"),
+         "--format", "json", "--show-suppressed"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO})
+    doc = json.loads(r.stdout)
+    assert doc["findings"] == [], doc["findings"]
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_repo_clean():
     """scripts/lint.sh (the pre-commit gate) must pass on the tree:
     zero non-suppressed ut-lint findings in uptune_tpu/."""

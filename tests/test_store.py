@@ -1,0 +1,500 @@
+"""Content-addressed results store (uptune_tpu/store/, docs/STORE.md):
+key derivation, the append-only segment layout (incl. the two-process
+atomic-append race), cache-hit serving through ProgramTuner (a repeated
+identical tune re-executes nothing), resume-vs-store equivalence under
+a counting evaluator, cross-tune warm start, multi-instance exchange,
+and the `bench.py --cache --quick` smoke + strict trace-guard CLI run
+that keep the serve path from rotting."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import uptune_tpu
+from uptune_tpu.api import constraint as C
+from uptune_tpu.api import session
+from uptune_tpu.exec.controller import ProgramTuner
+from uptune_tpu.store import (ResultStore, canon_config, eval_signature,
+                              scope_id, trial_key)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+ENV = {"PYTHONPATH": REPO}
+
+SIG = ["IntParam('x', 0, 100)", "IntParam('y', 0, 100)"]
+
+QUAD = textwrap.dedent("""
+    import uptune_tpu as ut
+    x = ut.tune(50, (0, 100), name="x")
+    y = ut.tune(50, (0, 100), name="y")
+    ut.target(float((x - 37) ** 2 + (y - 11) ** 2), "min")
+""")
+
+# counting evaluator: every REAL trial execution (not the profiling
+# run) appends its config to an exec log — re-executions are visible
+COUNTING = textwrap.dedent("""
+    import os
+    import uptune_tpu as ut
+    x = ut.tune(50, (0, 100), name="x")
+    y = ut.tune(50, (0, 100), name="y")
+    if os.environ.get("UT_TUNE_START"):
+        with open({log!r}, "a") as f:
+            f.write(f"{{x}},{{y}}\\n")
+    ut.target(float((x - 37) ** 2 + (y - 11) ** 2), "min")
+""")
+
+
+@pytest.fixture(autouse=True)
+def clean_env(monkeypatch):
+    for v in ("UT_BEFORE_RUN_PROFILE", "UT_TUNE_START", "BEST",
+              "UT_WORK_DIR", "UT_TRACE_GUARD"):
+        monkeypatch.delenv(v, raising=False)
+    C.REGISTRY.clear()
+    session.reset_settings()
+    yield
+
+
+def _mk(tmp_path, body, name="prog.py", **kw):
+    p = tmp_path / name
+    p.write_text(body)
+    kw.setdefault("parallel", 2)
+    kw.setdefault("env", ENV)
+    kw.setdefault("runtime_limit", 30.0)
+    return ProgramTuner([sys.executable, str(p)], str(tmp_path), **kw)
+
+
+def _exec_lines(log):
+    return [l for l in log.read_text().splitlines() if l.strip()] \
+        if log.exists() else []
+
+
+# ---------------------------------------------------------------------
+class TestKeys:
+    def test_key_stable_across_value_representations(self):
+        sc = scope_id(SIG, eval_signature(["true"], 0))
+        k1 = trial_key(sc, {"x": 3, "y": 0.5})
+        k2 = trial_key(sc, {"y": np.float32(0.5).item(), "x": np.int64(3)})
+        assert k1 == k2
+        assert canon_config({"b": -0.0}) == canon_config({"b": 0.0})
+
+    def test_key_sensitive_to_config_space_stage_command(self):
+        es = eval_signature(["true"], 0)
+        sc = scope_id(SIG, es)
+        base = trial_key(sc, {"x": 1})
+        assert trial_key(sc, {"x": 2}) != base
+        assert trial_key(scope_id(SIG[:1], es), {"x": 1}) != base
+        assert trial_key(scope_id(SIG, eval_signature(["true"], 1)),
+                         {"x": 1}) != base
+        assert trial_key(scope_id(SIG, eval_signature(["false"], 0)),
+                         {"x": 1}) != base
+
+    def test_command_is_content_addressed(self, tmp_path):
+        """Editing a file argument changes the signature; moving the
+        work dir (same content, different path) does not; the
+        interpreter collapses to 'python'."""
+        a = tmp_path / "a" / "prog.py"
+        b = tmp_path / "b" / "prog.py"
+        a.parent.mkdir()
+        b.parent.mkdir()
+        a.write_text("print(1)\n")
+        b.write_text("print(1)\n")
+        s_a = eval_signature([sys.executable, str(a)], 0)
+        assert eval_signature([sys.executable, str(b)], 0) == s_a
+        assert '"python"' in s_a and sys.executable not in s_a
+        b.write_text("print(2)\n")
+        assert eval_signature([sys.executable, str(b)], 0) != s_a
+
+    def test_program_named_python_is_still_content_hashed(self, tmp_path):
+        """Only the interpreter IDENTITY collapses: a tuned program
+        that happens to be named python.py keeps its content hash, so
+        editing it still invalidates its rows."""
+        p = tmp_path / "python.py"
+        p.write_text("print(1)\n")
+        s1 = eval_signature([sys.executable, str(p)], 0)
+        assert "file:python.py:" in s1
+        p.write_text("print(2)\n")
+        assert eval_signature([sys.executable, str(p)], 0) != s1
+
+    def test_env_forks_the_scope_but_pythonpath_does_not(self):
+        """Two tunes of one program under different build env measure
+        different things (CFLAGS!) and must not share rows; PYTHONPATH
+        is controller plumbing and must not fork the scope."""
+        base = eval_signature(["true"], 0, env={"CFLAGS": "-O0"})
+        assert eval_signature(["true"], 0, env={"CFLAGS": "-O3"}) != base
+        assert eval_signature(
+            ["true"], 0,
+            env={"CFLAGS": "-O0", "PYTHONPATH": "/anywhere"}) == base
+
+
+# ---------------------------------------------------------------------
+class TestResultStore:
+    def test_record_lookup_reopen_roundtrip(self, tmp_path):
+        root = str(tmp_path / "store")
+        with ResultStore(root, SIG, ["true"]) as st:
+            assert st.lookup({"x": 1, "y": 2}) is None
+            st.record({"x": 1, "y": 2}, 7.5, 0.25, u=[0.01, 0.02],
+                      perms=[])
+            row = st.lookup({"x": 1, "y": 2})
+            assert row["qor"] == 7.5 and row["u"] == [0.01, 0.02]
+        with ResultStore(root, SIG, ["true"]) as st2:
+            assert st2.lookup({"x": 1, "y": 2})["qor"] == 7.5
+            # different scope (other command) must not see the row
+        with ResultStore(root, SIG, ["false"]) as st3:
+            assert st3.lookup({"x": 1, "y": 2}) is None
+            assert st3.scope_rows() == []
+
+    def test_failures_recorded_not_served_and_upgraded(self, tmp_path):
+        with ResultStore(str(tmp_path), SIG, ["true"]) as st:
+            st.record({"x": 1}, None, 1.0)      # build failure
+            assert st.lookup({"x": 1}) is None
+            assert len(st) == 1                  # ...but bookkept
+            st.record({"x": 1}, 3.0, 1.0)        # retry succeeded
+            assert st.lookup({"x": 1})["qor"] == 3.0
+            # idempotent re-record: a finite row is never replaced
+            assert st.record({"x": 1}, 9.0, 1.0) is None
+            assert st.lookup({"x": 1})["qor"] == 3.0
+
+    def test_torn_tail_line_is_ignored_until_complete(self, tmp_path):
+        root = str(tmp_path)
+        st = ResultStore(root, SIG, ["true"])
+        st.record({"x": 1}, 1.0)
+        st.close()
+        seg = [f for f in os.listdir(root) if f.startswith("seg-")][0]
+        with open(os.path.join(root, seg), "a") as f:
+            f.write('{"k": "torn')          # crashed mid-append
+        st2 = ResultStore(root, SIG, ["true"])
+        assert len(st2) == 1                 # torn row invisible
+        assert st2.lookup({"x": 1})["qor"] == 1.0
+
+    def test_compact_merges_and_truncates_own_segment(self, tmp_path):
+        root = str(tmp_path)
+        a = ResultStore(root, SIG, ["true"])
+        for i in range(5):
+            a.record({"x": i}, float(i))
+        assert a.compact() == 5
+        a.close()
+        assert os.path.exists(os.path.join(root, "base.jsonl"))
+        assert not [f for f in os.listdir(root) if f.startswith("seg-")]
+        b = ResultStore(root, SIG, ["true"])
+        assert len(b) == 5 and b.lookup({"x": 3})["qor"] == 3.0
+
+    def test_best_row_respects_sense(self, tmp_path):
+        with ResultStore(str(tmp_path), SIG, ["true"]) as st:
+            st.record({"x": 1}, 5.0)
+            st.record({"x": 2}, 2.0)
+            st.record({"x": 3}, 9.0)
+            assert st.best_row("min")["qor"] == 2.0
+            assert st.best_row("max")["qor"] == 9.0
+
+
+RACER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    from uptune_tpu.store import ResultStore
+    root, tag = sys.argv[1], int(sys.argv[2])
+    st = ResultStore(root, {sig!r}, ["true"])
+    for i in range(250):
+        st.record({{"x": tag * 1000 + i}}, float(i), 0.01)
+    st.close()
+""")
+
+
+class TestMultiInstance:
+    def test_two_process_append_race(self, tmp_path):
+        """The atomic-segment protocol: two processes hammering one
+        store directory concurrently lose no rows and tear no lines."""
+        root = str(tmp_path / "race")
+        script = str(tmp_path / "racer.py")
+        with open(script, "w") as f:
+            f.write(RACER.format(repo=REPO, sig=SIG))
+        procs = [subprocess.Popen([sys.executable, script, root, str(t)],
+                                  env={**os.environ, **ENV})
+                 for t in (1, 2)]
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+        st = ResultStore(root, SIG, ["true"])
+        assert len(st) == 500
+        assert st.lookup({"x": 1000})["qor"] == 0.0
+        assert st.lookup({"x": 2249})["qor"] == 249.0
+
+    def test_sibling_compact_does_not_blind_running_peers(self, tmp_path):
+        """compact() replaces base.jsonl by rename and truncates the
+        caller's own segment: a RUNNING peer's remembered byte offsets
+        point into dead files, and must reset (inode/shrink check) so
+        post-compact appends stay visible."""
+        root = str(tmp_path)
+        a = ResultStore(root, SIG, ["true"], refresh_interval=0.0)
+        b = ResultStore(root, SIG, ["true"], refresh_interval=0.0)
+        for i in range(10):
+            a.record({"x": i}, float(i))
+        b.refresh()
+        assert len(b) == 10
+        a.compact()                      # base replaced, seg-A deleted
+        a.record({"x": 100}, 100.0)      # fresh seg-A, small file
+        b.refresh()
+        assert b.lookup({"x": 100})["qor"] == 100.0
+        assert len(b) == 11
+
+    def test_refresh_sees_sibling_appends(self, tmp_path):
+        root = str(tmp_path)
+        a = ResultStore(root, SIG, ["true"], refresh_interval=0.0)
+        b = ResultStore(root, SIG, ["true"], refresh_interval=0.0)
+        a.record({"x": 1}, 1.0)
+        assert b.lookup({"x": 1}) is None     # not yet refreshed
+        b.refresh()
+        assert b.lookup({"x": 1})["qor"] == 1.0
+        assert b.foreign_rows >= 1
+        b.record({"x": 2}, 2.0)
+        a.refresh()
+        assert a.lookup({"x": 2})["qor"] == 2.0
+
+
+# ---------------------------------------------------------------------
+class TestControllerServe:
+    def test_repeated_tune_eliminates_builds(self, tmp_path):
+        """A repeated identical lockstep tune must re-execute NOTHING:
+        run 2 serves every trial from the store (the BENCH_CACHE.json
+        protocol), and the counting evaluator proves no config ever
+        ran twice."""
+        log = tmp_path / "execs.log"
+        body = COUNTING.format(log=str(log))
+        kw = dict(parallel=1, prefetch=0, test_limit=6, seed=0)
+        pt1 = _mk(tmp_path, body, **kw)
+        res1 = pt1.run()
+        lines1 = _exec_lines(log)
+        assert pt1.pool.launched == len(lines1) > 0
+        pt2 = _mk(tmp_path, body, **kw)
+        res2 = pt2.run()
+        assert pt2.pool.launched == 0, "run 2 must build nothing"
+        assert pt2.store_hits == res2.evals - 1  # seed came profiled
+        assert _exec_lines(log) == lines1
+        # identical stream: run 2's archive replays run 1's trials —
+        # the same configs, served instead of built
+        assert res2.best_qor == res1.best_qor
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        cfgs = [json.dumps(r["cfg"], sort_keys=True) for r in rows]
+        assert len(cfgs) == res1.evals + res2.evals
+        assert set(cfgs) == {json.dumps(r["cfg"], sort_keys=True)
+                             for r in rows[:res1.evals]}
+
+    def test_store_off_disables(self, tmp_path):
+        pt = _mk(tmp_path, QUAD, test_limit=4, seed=1, store_dir="off")
+        pt.run()
+        assert pt.store is None
+        assert not (tmp_path / "ut.temp" / "store").exists()
+
+    def test_resume_never_reexecutes_recorded_configs(self, tmp_path):
+        """Kill-and-resume equivalence: the resumed run's archive is
+        duplicate-free and the counting evaluator saw every config
+        exactly once — archived rows are ingested into the store and
+        history, so neither replay nor re-proposal builds again."""
+        log = tmp_path / "execs.log"
+        body = COUNTING.format(log=str(log))
+        pt1 = _mk(tmp_path, body, parallel=1, test_limit=4, seed=4)
+        pt1.run()
+        n1 = len(_exec_lines(log))
+        pt2 = _mk(tmp_path, body, parallel=1, test_limit=10, seed=4,
+                  resume=True)
+        res = pt2.run()
+        assert res.evals == 10
+        lines = _exec_lines(log)
+        assert len(lines) == len(set(lines)), "a config ran twice"
+        assert len(lines) == n1 + pt2.pool.launched
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        cfgs = [json.dumps(r["cfg"], sort_keys=True) for r in rows]
+        assert len(cfgs) == len(set(cfgs)) == 10
+
+    def test_warm_start_from_sibling_work_dir(self, tmp_path):
+        """A second tune in a DIFFERENT work dir sharing the store
+        warm-starts: best-so-far at least as good as run 1's, recorded
+        configs never re-proposed (budget goes to new configs only)."""
+        wd1, wd2 = tmp_path / "a", tmp_path / "b"
+        wd1.mkdir()
+        wd2.mkdir()
+        store = str(tmp_path / "shared-store")
+        pt1 = _mk(wd1, QUAD, test_limit=6, seed=1, store_dir=store)
+        res1 = pt1.run()
+        pt2 = _mk(wd2, QUAD, test_limit=5, seed=1, store_dir=store,
+                  warm_start=True)
+        res2 = pt2.run()
+        assert res2.best_qor <= res1.best_qor
+        rows1 = [json.loads(l) for l in
+                 open(wd1 / "ut.archive.jsonl")][1:]
+        rows2 = [json.loads(l) for l in
+                 open(wd2 / "ut.archive.jsonl")][1:]
+        c1 = {json.dumps(r["cfg"], sort_keys=True) for r in rows1}
+        c2 = {json.dumps(r["cfg"], sort_keys=True) for r in rows2}
+        assert not (c1 & c2), "warm start re-measured a stored config"
+
+    def test_exchange_propagates_concurrent_sibling_best(self, tmp_path):
+        """Multi-instance exchange: while this instance tunes, a
+        'sibling' (a second ResultStore handle on the same directory)
+        appends the optimum.  The next refresh delta must inject it as
+        an 'exchange' trial, served from the store — the new best
+        propagates with zero build cost."""
+        from uptune_tpu.driver.plugins import SearchHook
+        store_root = str(tmp_path / "shared-store")
+        state = {"pt": None, "planted": False}
+
+        class Sibling(SearchHook):
+            def on_start(self, tuner):
+                # the controller opened its store just before building
+                # the tuner: tighten the refresh cadence for the test
+                state["pt"].store.refresh_interval = 0.0
+
+            def on_result(self, tuner, trial, qor):
+                if state["planted"]:
+                    return
+                state["planted"] = True
+                pt = state["pt"]
+                sib = ResultStore(
+                    store_root, [repr(s) for s in pt.tuner.space.specs],
+                    pt.command)
+                sib.record({"x": 37, "y": 11}, 0.0, 0.5)  # the optimum
+                sib.close()
+
+        pt = _mk(tmp_path, QUAD, test_limit=8, seed=3,
+                 store_dir=store_root, hooks=[Sibling()])
+        state["pt"] = pt
+        res = pt.run()
+        assert res.best_qor == 0.0
+        assert res.best_config == {"x": 37, "y": 11}
+        rows = [json.loads(l) for l in
+                open(tmp_path / "ut.archive.jsonl")][1:]
+        ex = [r for r in rows if r["tech"] == "exchange"]
+        assert len(ex) == 1 and ex[0]["qor"] == 0.0
+        assert pt.exchange_injected == 1
+        assert pt.store_hits >= 1   # the exchange trial was served
+
+
+    def test_warm_start_respects_session_constraints(self, tmp_path):
+        """Stored rows carry the RAW QoR; @ut.constraint must gate the
+        warm-start preload exactly as it gates serve-time hits — a
+        violating row must never become an unbeatable preloaded best
+        (and the exchange plane must not keep re-injecting it)."""
+        records = [{"name": "x", "type": "int", "default": 50,
+                    "lo": 0, "hi": 100}]
+        (tmp_path / "ut.params.json").write_text(json.dumps([records]))
+        from uptune_tpu.exec.space_io import space_from_params
+        sig = [repr(s) for s in space_from_params(records).specs]
+        store_dir = str(tmp_path / "store")
+        with ResultStore(store_dir, sig, ["true"]) as seedst:
+            seedst.record({"x": 1}, 5.0, 0.1)    # raw best, VIOLATES
+            seedst.record({"x": 2}, 30.0, 0.1)   # valid
+
+        @uptune_tpu.constraint()
+        def floor(qor, cfg):
+            return qor > 20.0
+
+        pt = ProgramTuner(["true"], str(tmp_path), parallel=1,
+                          test_limit=2, seed=0, store_dir=store_dir,
+                          warm_start=True, env=ENV, runtime_limit=10.0)
+        res = pt.run()
+        assert res.best_qor == 30.0, \
+            "violating stored row leaked into best-so-far"
+        assert pt.exchange_injected <= 1
+
+
+# ---------------------------------------------------------------------
+class TestTunerPreload:
+    def test_preload_sets_best_without_counters(self):
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.workloads import rosenbrock_space
+        space = rosenbrock_space(4, -3.0, 3.0)
+        t = Tuner(space, None, seed=0)
+        cands = space.random(__import__("jax").random.PRNGKey(7), 8)
+        u = np.asarray(cands.u)
+        qor = np.arange(8, dtype=np.float32) + 5.0
+        n = t.preload(u, [np.asarray(p) for p in cands.perms], qor)
+        assert n == 8
+        assert float(t.best.qor) == 5.0
+        assert t.evals == 0 and t.told == 0 and t.trace == []
+        # preloaded rows are history-known: injecting one opens no trial
+        cfg = space.to_configs(cands[np.asarray([0])])[0]
+        assert t.inject([cfg]) == []
+        # non-finite rows are dropped
+        assert t.preload(u[:2], [np.asarray(p)[:2] for p in cands.perms],
+                         [float("inf"), float("nan")]) == 0
+
+    def test_preload_never_double_trains_surrogate(self):
+        """Rows already in the dedup history (a --resume replay
+        followed by a warm start over the same trials) must not be
+        observed into the surrogate training set a second time."""
+        import jax
+
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.workloads import rosenbrock_space
+        space = rosenbrock_space(2, -3.0, 3.0)
+        t = Tuner(space, None, seed=0, surrogate="gp",
+                  surrogate_opts={"min_points": 64})
+        cands = space.random(jax.random.PRNGKey(3), 8)
+        u = np.asarray(cands.u)
+        perms = [np.asarray(p) for p in cands.perms]
+        qor = np.arange(8, dtype=np.float32)
+        t.preload(u, perms, qor, refit=False)
+        assert t.surrogate.n_points == 8
+        t.preload(u, perms, qor, refit=False)
+        assert t.surrogate.n_points == 8, "history dups re-observed"
+
+
+# ---------------------------------------------------------------------
+class TestSurrogateWarmStart:
+    def test_manager_warm_start_fits_immediately(self):
+        """SurrogateManager.warm_start (the library-mode ingestion
+        hook): bulk rows + an immediate fit, ignoring the online
+        refit_interval cadence."""
+        import jax
+
+        from uptune_tpu.surrogate.manager import SurrogateManager
+        from uptune_tpu.workloads import rosenbrock_space
+        space = rosenbrock_space(2, -3.0, 3.0)
+        sm = SurrogateManager(space, "gp", min_points=8,
+                              refit_interval=512)
+        cands = space.random(jax.random.PRNGKey(0), 16)
+        feats = np.asarray(space.features(cands))
+        assert not sm.fitted
+        assert sm.warm_start(feats, np.arange(16, dtype=np.float32))
+        assert sm.fitted and sm.n_points == 16
+
+
+# ---------------------------------------------------------------------
+class TestEndToEndGates:
+    def test_cache_bench_quick_smoke(self, tmp_path):
+        """`bench.py --cache --quick` must keep producing its evidence
+        JSON with full elimination on the lockstep repeat protocol —
+        the cache path can't silently rot."""
+        env = {**os.environ, **ENV}
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--cache",
+             "--quick"], capture_output=True, text=True, env=env,
+            cwd=str(tmp_path), timeout=420)
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["metric"] == "store_build_elimination"
+        assert out["value"] >= 0.9
+        assert out["run2"]["builds"] == 0
+        assert os.path.exists(os.path.join(REPO,
+                                           "BENCH_CACHE.quick.json"))
+
+    def test_full_ut_run_strict_trace_guard_with_store(self, tmp_path):
+        """Acceptance gate: a full `ut` CLI tune with the store enabled
+        (default) passes UT_TRACE_GUARD=strict — the serve path adds no
+        retraces."""
+        prog = tmp_path / "prog.py"
+        prog.write_text(QUAD)
+        env = {**os.environ, **ENV, "UT_TRACE_GUARD": "strict"}
+        r = subprocess.run(
+            [sys.executable, "-m", "uptune_tpu.cli", str(prog),
+             "--test-limit", "6", "-pf", "2"],
+            capture_output=True, text=True, env=env, cwd=str(tmp_path),
+            timeout=420)
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["evals"] >= 6
+        assert (tmp_path / "ut.temp" / "store").is_dir()

@@ -43,6 +43,16 @@ DEFAULTS: Dict[str, Any] = {
     # controller appends a per-space-signature subdir, so repeated tunes
     # of the same program skip first-step compiles
     "compile-cache-dir": None,
+    # content-addressed trial results store (docs/STORE.md): directory
+    # of append-only result shards consulted before every build — a hit
+    # serves the recorded QoR without launching the program, and N
+    # concurrent instances sharing one directory exchange results.
+    # None = <work_dir>/ut.temp/store; the literal 'off' disables
+    "store-dir": None,
+    # warm-start a fresh tune from the store's recorded rows for the
+    # same (space, program): preload best-so-far + dedup history +
+    # surrogate training set before the first acquisition
+    "warm-start": False,
 }
 
 settings: Dict[str, Any] = dict(DEFAULTS)

@@ -3,9 +3,11 @@
 The reference declares this hook as a stub (`/root/reference/python/
 uptune/tuners/tuner.py:7-14`) — the decorated function was stored and
 never called.  Here a registered model is a real proposal source: the
-controller wraps it as a host-side technique arm (see
-`uptune_tpu.exec.tuner.HostArm`) that competes under the AUC bandit like
-any built-in technique.
+controller asks it for configs at startup
+(`uptune_tpu.exec.controller.ProgramTuner._host_proposals`) and injects
+them as attributed trials via `Tuner.inject` — evaluated ahead of any
+technique batch, archived under the model's name, but outside the AUC
+bandit's credit loop (injected tickets never touch technique state).
 
 A model is a callable ``(history, space) -> config_dict`` where history
 is a list of ``(config_dict, qor)`` pairs seen so far.

@@ -115,6 +115,26 @@ def build_parser() -> argparse.ArgumentParser:
                         ".xla_cache at the repo root / "
                         "~/.cache/uptune_tpu/xla; pass 'off' to "
                         "disable)")
+    p.add_argument("--store-dir", default=None, metavar="DIR",
+                   help="content-addressed trial results store "
+                        "(docs/STORE.md): consulted before every build "
+                        "— a previously measured config is served its "
+                        "recorded QoR without launching the program, "
+                        "and N concurrent ut processes sharing one "
+                        "store directory exchange results and "
+                        "new-bests (default: ut.temp/store under the "
+                        "work dir; pass 'off' to disable)")
+    p.add_argument("--store", choices=("on", "off"), default=None,
+                   help="force the results store on/off regardless of "
+                        "--store-dir ('off' wins over any directory)")
+    p.add_argument("--warm-start", action="store_true", default=None,
+                   help="preload this (space, program)'s stored trials "
+                        "before the first acquisition: best-so-far, "
+                        "dedup history (recorded configs are never "
+                        "re-proposed) and the surrogate training set "
+                        "all start warm — spend the whole budget on "
+                        "NEW configs instead of replaying a cached "
+                        "stream")
     p.add_argument("--params", default=None,
                    help="reuse an existing ut.params.json")
     p.add_argument("--resume", action="store_true",
@@ -402,6 +422,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         seed_cfgs.extend(loaded)
 
+    store_dir = args.store_dir
+    if args.store == "off":
+        store_dir = "off"
+    elif args.store == "on" and store_dir is None:
+        # force-enable ONLY overrides a disabled config: a store-dir
+        # configured via ut.config keeps winning (--store on means
+        # "make sure it runs", not "ignore where it runs)"
+        cfg_dir = settings["store-dir"]
+        if cfg_dir is None or (isinstance(cfg_dir, str)
+                               and cfg_dir.lower() in ("off", "none")):
+            store_dir = "default"   # ut.temp/store under the work dir
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,
         parallel=args.parallel_factor, test_limit=args.test_limit,
@@ -410,7 +441,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         resume=args.resume, sandbox=not args.no_sandbox,
         surrogate=surrogate, surrogate_opts=sopts, template=template,
         seed_configs=seed_cfgs, prefetch=args.prefetch,
-        compile_cache_dir=args.compile_cache_dir)
+        compile_cache_dir=args.compile_cache_dir,
+        store_dir=store_dir, warm_start=args.warm_start)
 
     if args.cfg:
         for k in sorted(settings):

@@ -494,25 +494,18 @@ class Tuner:
             return
         if not rows:
             return
-        B = len(rows)
         u = np.asarray([r["u"] for r in rows], np.float32)
-        perms = tuple(
+        perms = [
             np.asarray([r["perms"][k] for r in rows], np.int32)
-            for k in range(len(self.space.perm_sizes)))
+            for k in range(len(self.space.perm_sizes))]
         # archive rows are user-oriented; engine-internal = sign * user
         qor = self.sign * np.asarray([r["qor"] for r in rows], np.float32)
-        cands = CandBatch(jnp.asarray(u), tuple(jnp.asarray(p) for p in perms))
-        hashes, found, known, src, novel = self._dedup(self.hist_state, cands)
-        self.hist_state, self.best = self._commit(
-            self.hist_state, self.best, hashes, cands, jnp.asarray(qor),
-            novel)
+        self._ingest_batch(u, perms, qor)
         if self.surrogate is not None:
             # replayed trials are training data too: without this the
             # surrogate restarts cold after every resume while the
             # techniques resume warm (reference resume() replays into
             # the DBs its surrogate trains from, api.py:341-363)
-            self.surrogate.observe(
-                np.asarray(self.space.features(cands)), qor)
             self.surrogate.maybe_refit()
         self.gid = max(int(r["gid"]) for r in rows) + 1
         self.evals = len(rows) + compacted
@@ -521,6 +514,83 @@ class Tuner:
         for q in qor:
             running = min(running, float(q))
             self.trace.append(self.sign * running)
+
+    def _ingest_batch(self, u_np: np.ndarray, perms_np: List[np.ndarray],
+                      qor_np: np.ndarray) -> None:
+        """Commit externally-measured rows (exact unit vectors,
+        ENGINE-oriented QoR) into history + best (+ surrogate training
+        set) in bucket-sized chunks padded by repeating row 0, so
+        archive replay and store warm-starts run through the SAME
+        `_dedup`/`_commit` avals as the live tune and add no traces
+        (the strict one-trace-per-program guarantee, docs/PERF.md).
+        Counters/trace/archive are untouched — callers own those."""
+        total = len(qor_np)
+        bucket = self._bucket
+        for s in range(0, total, bucket):
+            n = min(bucket, total - s)
+            cu = u_np[s:s + n]
+            cp = [p[s:s + n] for p in perms_np]
+            cq = qor_np[s:s + n]
+            if n < bucket:
+                pad = bucket - n
+                cu = np.concatenate([cu, np.repeat(cu[:1], pad, axis=0)])
+                cp = [np.concatenate([p, np.repeat(p[:1], pad, axis=0)])
+                      for p in cp]
+                cq = np.concatenate([cq, np.repeat(cq[:1], pad)])
+            cands = CandBatch(jnp.asarray(cu),
+                              tuple(jnp.asarray(p) for p in cp))
+            hashes, found, known, src, novel = self._dedup(
+                self.hist_state, cands)
+            self.hist_state, self.best = self._commit(
+                self.hist_state, self.best, hashes, cands,
+                jnp.asarray(cq), novel)
+            if self.surrogate is not None:
+                # padding rows duplicate row 0 (sliced off via [:n]),
+                # and rows ALREADY in the dedup history were observed
+                # when they first entered it — e.g. a --resume replay
+                # followed by a store warm-start covering the same
+                # trials must not double-weight them in the training
+                # set — so only history-novel rows train
+                fresh = ~np.asarray(found)[:n]
+                if fresh.any():
+                    feats = np.asarray(self.space.features(cands))[:n]
+                    self.surrogate.observe(feats[fresh], cq[:n][fresh])
+        self._last_dropped = int(self.hist_state.dropped)
+
+    def preload(self, u, perms, qor, refit: bool = True) -> int:
+        """Warm-start ingestion of externally-recorded trials (the
+        results store's cross-tune path, uptune_tpu/store/): rows enter
+        the dedup history — never re-proposed, and dup-served their
+        recorded QoR if a technique finds them again — fold into the
+        best-so-far, and train the surrogate.  They touch NO run
+        counters (evals/told/steps), archive rows, or trace entries:
+        prior knowledge, not this run's work.
+
+        `u` is [B, n_scalar] unit vectors, `perms` a list of [B, size]
+        index arrays (one per perm spec), `qor` USER-oriented values;
+        non-finite rows are dropped.  Returns the rows ingested."""
+        u = np.atleast_2d(np.asarray(u, np.float32))
+        qor_e = self.sign * np.asarray(qor, np.float32).reshape(-1)
+        perms_np = [np.asarray(p, np.int32) for p in (perms or [])]
+        if len(perms_np) != len(self.space.perm_sizes):
+            raise ValueError(
+                f"preload needs {len(self.space.perm_sizes)} perm "
+                f"arrays, got {len(perms_np)}")
+        keep = np.isfinite(qor_e)
+        if not keep.all():
+            u = u[keep]
+            perms_np = [p[keep] for p in perms_np]
+            qor_e = qor_e[keep]
+        if not len(qor_e):
+            return 0
+        self._ingest_batch(u, perms_np, qor_e)
+        sm = self.surrogate
+        if refit and sm is not None:
+            if hasattr(sm, "force_refit"):
+                sm.force_refit()   # warm guidance live from trial 1
+            else:
+                sm.maybe_refit()
+        return int(len(qor_e))
 
     def _log_trial(self, gid, tech, cfg, u_row, perm_rows, qor, is_best,
                    dur) -> None:

@@ -16,6 +16,11 @@ The TPU-native re-design of the reference's controller stack
    checks, and @ut.model host proposal sources.
 4. Persist best.json on every improvement (api.py:146-149) and the jsonl
    trial archive for resume.
+5. Content-addressed results store (uptune_tpu/store/, docs/STORE.md):
+   every trial is looked up before launch — a hit serves the recorded
+   QoR without a build — every measured result is recorded back, and
+   concurrent instances sharing one store directory exchange results
+   (the reference's SQLite result-database sync, api.py SQLAlchemy).
 """
 from __future__ import annotations
 
@@ -25,6 +30,8 @@ import logging
 import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
 
 from ..api.constraint import REGISTRY
 from ..api.session import settings, write_best
@@ -64,7 +71,9 @@ class ProgramTuner:
                  template=None, hooks=None,
                  seed_configs: Optional[List[Dict]] = None,
                  prefetch: Optional[int] = None,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 store_dir: Optional[str] = None,
+                 warm_start: Optional[bool] = None):
         # seed_configs: known-good configurations injected as 'seed'
         # trials at startup (the reference's --seed-configuration file
         # loading, opentuner/search/driver.py:37-42) — warm-starts
@@ -165,6 +174,19 @@ class ProgramTuner:
         self.compile_cache_dir = (
             compile_cache_dir if compile_cache_dir is not None
             else settings["compile-cache-dir"])
+        # content-addressed results store (uptune_tpu/store/,
+        # docs/STORE.md): consulted before every build — a hit serves
+        # the recorded QoR through tell() without launching anything;
+        # results land back in it as they are measured, and concurrent
+        # instances sharing one directory exchange them.  None resolves
+        # to <work_dir>/ut.temp/store; the literal 'off' disables.
+        self.store_dir = (store_dir if store_dir is not None
+                          else settings["store-dir"])
+        self.warm_start = bool(warm_start if warm_start is not None
+                               else settings["warm-start"])
+        self.store = None
+        self.store_hits = 0        # builds eliminated by cache hits
+        self.exchange_injected = 0  # sibling-instance bests ingested
         # observability: speculative trials withdrawn after a tell()
         # landed a new best (their tickets were proposed around the
         # stale incumbent)
@@ -316,6 +338,146 @@ class ProgramTuner:
         queue.extend(kept)
         return n
 
+    # ------------------------------------------------------------------
+    def _open_store(self, space):
+        """Open the results store for this (space, command, stage)
+        scope, or return None when disabled ('off')."""
+        base = self.store_dir
+        if isinstance(base, str) and base.lower() in ("off", "none"):
+            return None
+        if base is None or (isinstance(base, str)
+                            and base.lower() in ("on", "default")):
+            base = os.path.join(self.work_dir, "ut.temp", "store")
+        from ..store import ResultStore
+        extra = ([self.template.path] if self.template is not None
+                 else None)
+        return ResultStore(base, [repr(s) for s in space.specs],
+                           self.command, stage=self.stage,
+                           extra_files=extra, env=self.env_extra)
+
+    @staticmethod
+    def _verdict(qor: Optional[float],
+                 config: Dict[str, Any]) -> Optional[float]:
+        """USER-oriented QoR -> the tell() verdict: an @ut.constraint
+        violation becomes a failure (None).  The ONE rule shared by
+        the poll loop, the wall-limit drain, store-hit serving, and
+        the profiled seed default."""
+        if qor is not None and REGISTRY.constraints and \
+                not REGISTRY.check_qor(qor, config):
+            return None
+        return qor
+
+    def _record_result(self, trial: Trial, qor: Optional[float],
+                       dur: float, info: Dict[str, Any]) -> None:
+        """Measured trial -> store row.  The RAW QoR is recorded (the
+        @ut.constraint verdict is session policy, re-applied at serve
+        time); timeouts are not recorded at all — they depend on this
+        run's --runtime-limit and another instance with a wider limit
+        may succeed."""
+        if self.store is None or info.get("timeout"):
+            return
+        tk = trial.ticket
+        self.store.record(
+            trial.config, qor, dur, u=tk.u_np[trial.slot],
+            perms=[p[trial.slot] for p in tk.perms_np])
+
+    def _serve_hit(self, trial: Trial, row: Dict[str, Any],
+                   queue) -> None:
+        """A store hit: synthesize the trial result and tell() it
+        immediately — no build, but FULL accounting (told/evals budget,
+        archive row, surrogate observation, bandit credit) and the same
+        new-best speculative invalidation a pool result triggers."""
+        qor = self._verdict(row.get("qor"), trial.config)
+        stats = self.tuner.tell(trial, qor, float(row.get("dur", 0.0)))
+        if qor is not None:
+            self._host_history.append((trial.config, qor))
+        if stats is not None and stats.was_new_best and self.prefetch:
+            self.spec_cancelled += self._cancel_speculative(
+                queue, self.tuner)
+        self._maybe_new_best(stats)
+        self._status(qor)
+
+    def _warm_start_from_store(self) -> int:
+        """Preload the store's recorded rows for this scope into the
+        tuner: best-so-far + dedup history + surrogate training set,
+        with no budget/archive impact (Tuner.preload).  Rows carrying
+        exact unit vectors replay bit-exactly; legacy rows without them
+        are re-encoded from their configs (close enough for warm-start
+        dedup — a boundary float that re-encodes differently just gets
+        re-measured once)."""
+        store, tuner = self.store, self.tuner
+        rows = store.scope_rows()
+        if REGISTRY.constraints:
+            # stored rows carry the RAW QoR; @ut.constraint is session
+            # policy and must gate here exactly as it gates serve-time
+            # hits — otherwise a violating row becomes an unbeatable
+            # preloaded best and the tune reports a forbidden config
+            rows = [r for r in rows
+                    if REGISTRY.check_qor(r["qor"], r["cfg"])]
+        if not rows:
+            return 0
+        space = tuner.space
+        sizes = space.perm_sizes
+
+        def exact(r):
+            u, pp = r.get("u"), r.get("perms")
+            return (u is not None and len(u) == space.n_scalar
+                    and len(pp or []) == len(sizes)
+                    and all(len(p) == s for p, s in zip(pp or [], sizes)))
+
+        ex = [r for r in rows if exact(r)]
+        ap = [r for r in rows if not exact(r)]
+        n = 0
+        if ex:
+            u = np.asarray([r["u"] for r in ex], np.float32)
+            perms = [np.asarray([r["perms"][k] for r in ex], np.int32)
+                     for k in range(len(sizes))]
+            n += tuner.preload(u, perms, [r["qor"] for r in ex],
+                               refit=not ap)
+        if ap:
+            cb = space.from_configs([r["cfg"] for r in ap])
+            n += tuner.preload(np.asarray(cb.u),
+                               [np.asarray(p) for p in cb.perms],
+                               [r["qor"] for r in ap])
+        res = tuner.result()
+        log.info("[ut] warm start: %d stored trials preloaded "
+                 "(best=%.6g)", n, res.best_qor)
+        return n
+
+    def _maybe_exchange_best(self, queue) -> None:
+        """Multi-instance exchange: when refresh() brings in sibling
+        rows, inject the incoming best as an 'exchange' trial if it
+        beats our incumbent.  It will be a store hit at launch time —
+        entering this instance's history/best/archive with full
+        accounting and zero build cost (the reference's SQLite-sync
+        new-best propagation, api.py SQLAlchemy plane).
+
+        Acts ONLY on the store's fresh-foreign delta feed
+        (`pop_fresh_rows`): rows already present at store open are a
+        previous run's results — importing those up front would steer
+        the techniques around them and break the exact cache replay of
+        a repeated tune (the BENCH_CACHE protocol).  Cross-RUN
+        propagation is `--warm-start`'s job.  A sibling's raw best may
+        also violate THIS session's @ut.constraint — such rows are
+        dropped, never injected (serving one would just burn a budget
+        trial as a failure)."""
+        rows = self.store.pop_fresh_rows()
+        if REGISTRY.constraints:
+            rows = [r for r in rows
+                    if REGISTRY.check_qor(r["qor"], r["cfg"])]
+        if not rows:
+            return
+        tuner = self.tuner
+        pick = min if self.sense == "min" else max
+        row = pick(rows, key=lambda r: float(r["qor"]))
+        if tuner.sign * float(row["qor"]) >= float(tuner.best.qor):
+            return
+        injected = tuner.inject([row["cfg"]], source="exchange")
+        if injected:
+            self.exchange_injected += len(injected)
+            # serve ahead of speculative technique work
+            queue.extendleft(reversed(injected))
+
     def _host_proposals(self, space) -> List[Trial]:
         """Ask @ut.model proposal sources for one config each."""
         trials: List[Trial] = []
@@ -344,21 +506,31 @@ class ProgramTuner:
         records = self.params[self.stage]
         space = space_from_params(records)
         self._enable_compile_cache(space)
+        store = self.store = self._open_store(space)
         self.tuner = tuner = self._make_tuner(space)
         # the CLI drives ask/tell (not Tuner.run), so the run-budget
         # surrogate rule is applied here where the limit is known
         tuner._apply_budget_rule(limit)
+        if store is not None:
+            if self.resume and os.path.exists(self.archive):
+                # the replayed archive doubles as store rows, so runs
+                # recorded before the store existed (or whose store dir
+                # was lost) still never re-execute an archived config
+                store.ingest_archive(self.archive)
+            if self.warm_start:
+                self._warm_start_from_store()
 
         queue: collections.deque = collections.deque()
         # seed trial: the program's declared defaults; its QoR was already
         # measured by the profiling run, so tell() it without a subprocess
         seed_trials = tuner.inject([default_config(records)], "seed")
-        dq = self.default_qor
-        if dq is not None and REGISTRY.constraints and \
-                not REGISTRY.check_qor(dq, default_config(records)):
-            dq = None   # the default itself violates a QoR constraint
+        # the default itself may violate a QoR constraint
+        dq = self._verdict(self.default_qor, default_config(records))
         if seed_trials and dq is not None:
             for tr in seed_trials:
+                # the profiling run measured the defaults: that is a
+                # real result, record it for sibling/future tunes
+                self._record_result(tr, dq, 0.0, {})
                 self._maybe_new_best(tuner.tell(tr, dq))
         else:
             queue.extend(seed_trials)
@@ -379,7 +551,6 @@ class ProgramTuner:
                                   if k in defaults}})
             queue.extend(tuner.inject(merged, "seed"))
         queue.extend(self._host_proposals(space))
-
         pre_launch = None
         if self.template is not None:
             name = os.path.basename(self.template.path)
@@ -390,6 +561,10 @@ class ProgramTuner:
 
         t0 = time.time()
         dry_asks = 0
+        # gid of a queue head already looked up and missed while every
+        # slot was busy: don't re-hash it each poll iteration (reset
+        # when a refresh merges new rows — the answer may have changed)
+        miss_gid = -1
         with WorkerPool(self.command, self.work_dir, self.parallel,
                         runtime_limit=self.runtime_limit,
                         env=self.env_extra,
@@ -407,9 +582,23 @@ class ProgramTuner:
                 # queue — no device work on this path.  Gate on told
                 # (per-trial), not evals (per-ticket): a wide in-flight
                 # ticket must still count against the budget, or a
-                # --test-limit 25 run launches 50+ trials
-                while queue and pool.n_free and \
-                        tuner.told + pool.busy_count < limit:
+                # --test-limit 25 run launches 50+ trials.  A trial
+                # whose config the store already holds is served INLINE
+                # (no slot, no build): the recorded QoR flows through
+                # tell() with full accounting, and the loop keeps
+                # draining — store hits don't wait for free slots
+                while queue and tuner.told + pool.busy_count < limit:
+                    head = queue[0]
+                    hit = (store.lookup(head.config)
+                           if store is not None and head.gid != miss_gid
+                           else None)
+                    if hit is not None:
+                        self.store_hits += 1
+                        self._serve_hit(queue.popleft(), hit, queue)
+                        continue
+                    if not pool.n_free:
+                        miss_gid = head.gid
+                        break
                     pool.submit(queue.popleft(), stage=self.stage)
                 # 2. speculative prefetch: top the queue back up to
                 # `prefetch` trials while every slot is busy building,
@@ -427,15 +616,20 @@ class ProgramTuner:
                     dry_asks = 0 if asked else dry_asks + 1
                     if asked and pool.n_free:
                         continue  # launch the fresh trials before polling
+                # multi-instance exchange: pick up sibling instances'
+                # freshly appended rows (time-gated re-scan) and pull
+                # in their best when it beats ours
+                if store is not None and store.maybe_refresh():
+                    miss_gid = -1   # new rows: head may hit now
+                    self._maybe_exchange_best(queue)
                 if pool.busy_count == 0:
                     if tuner.told >= limit:
                         break
                     if not queue and dry_asks >= 8:
                         break  # space saturated: nothing left to propose
                 for trial, qor, dur, info in pool.poll(self.interval):
-                    if qor is not None and REGISTRY.constraints and \
-                            not REGISTRY.check_qor(qor, trial.config):
-                        qor = None  # constraint violation = failure
+                    self._record_result(trial, qor, dur, info)
+                    qor = self._verdict(qor, trial.config)
                     stats = tuner.tell(trial, qor, dur)
                     if qor is not None:
                         self._host_history.append((trial.config, qor))
@@ -454,7 +648,9 @@ class ProgramTuner:
                 if wall_limit and time.time() - t0 > wall_limit:
                     for trial, qor, dur, info in pool.drain(
                             timeout=self.runtime_limit):
-                        tuner.tell(trial, qor, dur)
+                        self._record_result(trial, qor, dur, info)
+                        tuner.tell(trial, self._verdict(
+                            qor, trial.config), dur)
                     break
             # withdraw trials still queued (never launched): no archive
             # rows, no failure penalty — the limit simply arrived first
@@ -469,9 +665,17 @@ class ProgramTuner:
                 "cancels=%d)", pool.utilization(),
                 tuner.t_propose_total, tuner.t_dedup_total,
                 tuner.t_eval_wait_total, self.spec_cancelled)
+            if store is not None:
+                log.info(
+                    "[ut] store: %d build(s) eliminated by cache hits, "
+                    "%d launched, %d exchange trial(s) ingested (%s)",
+                    self.store_hits, pool.launched,
+                    self.exchange_injected, store.stats())
         res = tuner.result()
         if res.best_config:
             write_best(res.best_config, res.best_qor,
                        work_dir=self.work_dir)
         tuner.close()
+        if store is not None:
+            store.close()
         return res

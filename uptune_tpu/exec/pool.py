@@ -154,6 +154,15 @@ class WorkerPool:
     def n_free(self) -> int:
         return sum(1 for s in self._slots if not s.busy)
 
+    def stats(self) -> Dict[str, Any]:
+        """Pool-side scoreboard (logs + bench artifacts): launches =
+        real builds started (the store's cache hits never reach here),
+        dead-worker replacements, slot-seconds spent building, and
+        utilization."""
+        return {"launched": self.launched, "replaced": self.replaced,
+                "busy_s": round(self.busy_s, 4),
+                "utilization": round(self.utilization(), 4)}
+
     def utilization(self) -> float:
         """Fraction of slot-seconds spent running trials since start()
         (reaped trials only).  1.0 = every slot always building; the gap

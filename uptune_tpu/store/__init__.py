@@ -1,0 +1,10 @@
+"""Content-addressed trial results store (store.py) and its key
+derivation (keys.py): cache hits instead of repeated external builds,
+cross-tune warm starts, and multi-instance best-exchange over one
+shared directory.  See docs/STORE.md."""
+from .keys import (canon_config, eval_signature, scope_id,  # noqa: F401
+                   trial_key)
+from .store import ResultStore  # noqa: F401
+
+__all__ = ["ResultStore", "canon_config", "eval_signature", "scope_id",
+           "trial_key"]

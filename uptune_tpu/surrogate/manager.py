@@ -321,6 +321,23 @@ class SurrogateManager:
             self._online_cat_w = w
         return True
 
+    def force_refit(self) -> bool:
+        """Fit NOW if the point count allows, ignoring the
+        `refit_interval` cadence — the warm-start hook: after a bulk
+        ingestion of stored trials the model should guide from the very
+        first live acquisition instead of waiting out the online
+        cadence."""
+        self._since_fit = max(self._since_fit, self.refit_interval)
+        return self.maybe_refit()
+
+    def warm_start(self, feats: np.ndarray, qor: np.ndarray) -> bool:
+        """Bulk-ingest externally-recorded (features, engine-oriented
+        QoR) rows — the results store's cross-tune training set
+        (docs/STORE.md) — and fit immediately.  Returns True when the
+        model came out fitted."""
+        self.observe(feats, qor)
+        return self.force_refit()
+
     def _flip_probs(self) -> jax.Array:
         """[n_scalar] per-lane probability weights for the pool's
         categorical flip moves: uniform by default; with an online
