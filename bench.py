@@ -408,12 +408,261 @@ def cache_main() -> None:
     print(json.dumps(result))
 
 
+def surrogate_main() -> None:
+    """`bench.py --surrogate`: the async-surrogate-plane microbenchmark
+    (docs/PERF.md "Async surrogate plane").
+
+    Protocol A — matched-seed lockstep tell latency: the SAME tune
+    (space, seed, objective, calibrated surrogate opts) is driven
+    through ask()/tell() twice, `--surrogate-async off` then `on`, and
+    every tell() is wall-clocked.  Tells are bucketed into REFIT
+    WINDOWS (a full fit was launched/ran inside that finalize — where
+    sync mode pays the O(N^3) fit + fit_auto sweep inline) vs steady
+    tells; the headline is the sync/async ratio of the refit-window
+    p95.  The first two windows per mode are excluded as compile
+    warmup (each fit bucket's first use pays XLA lowering in BOTH
+    modes; steady state is what a long tune lives in).
+
+    Protocol B (full mode only; --quick is the tier-1 smoke and runs
+    protocol A alone) — BENCHREPORT spot-check: iterations-to-optimum
+    on rosenbrock-2d/-4d at 5 matched seeds each, sync vs async
+    WITHOUT any drain barrier (the real, timing-dependent regime),
+    medians + IQR recorded to show search quality is statistically
+    unchanged.
+
+    Run under UT_TRACE_GUARD=strict to also prove the incremental
+    Cholesky extensions add no retraces (per-bucket wrappers are built
+    up-front).  Writes BENCH_SURROGATE.json (.quick.json for
+    --quick)."""
+    quick = "--quick" in sys.argv
+    from uptune_tpu.utils.platform_guard import (enable_compile_cache,
+                                                 force_cpu)
+    # TWO virtual devices — the deployment shape the async plane
+    # assumes: driver programs on device 0, background fits on device 1
+    # (a single device would serialize the fit against every driver
+    # dispatch; see SurrogateManager._refit_device)
+    force_cpu(2)
+    import jax  # noqa: F401  (backend must init after force_cpu)
+    import numpy as np
+
+    from uptune_tpu.analysis.trace_guard import guard_from_env
+    from uptune_tpu.calibrated import CALIBRATED_OPTS
+    from uptune_tpu.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_objective, rosenbrock_space
+
+    # the protocol builds many Tuners (sync + async + spot-check
+    # seeds); the persistent compile cache keeps the repeated driver /
+    # fit-bucket compiles from dominating the --quick smoke budget.
+    # Latency percentiles are unaffected: compile warmup windows are
+    # excluded either way
+    enable_compile_cache(subdir="bench-surrogate")
+
+    # full mode runs 1000 lockstep tells: background fits at bucket 512
+    # take ~1 s, so the async side opens a refit window only every
+    # ~100+ tells — a shorter run leaves its p95 resting on a handful
+    # of windows
+    trials = 150 if quick else 1000
+    # the latency protocol probes the LEARNING-COST regime the async
+    # plane exists for: max_points 512 (between the calibrated 256 and
+    # the manager default 1024), where the O(N^3) fit + 43-point
+    # fit_auto sweep costs ~1 s inline on this class of box.  quick
+    # caps the bucket at 64 instead so the smoke run REACHES steady
+    # state inside its budget: a first fit at a new bucket pays Python
+    # tracing, which no thread can hide (the GIL), and at larger caps
+    # every quick-run window would be such a first fit.  The protocol-B
+    # spot-check keeps the calibrated 256 (search quality is measured
+    # at the shipping configuration).
+    sopts = dict(CALIBRATED_OPTS)
+    sopts["max_points"] = 64 if quick else 512
+    space = rosenbrock_space(2, -2.048, 2.048)
+    obj = rosenbrock_objective(2)
+
+    def lat_run(async_on):
+        tuner = Tuner(space, None, seed=0, surrogate="gp",
+                      surrogate_opts={**sopts, "async_refit": async_on})
+        sm = tuner.surrogate
+        lats, blocked, windows, warm = [], [], [], []
+        seen_buckets = set()
+        done = 0
+        while done < trials:
+            for tr in tuner.ask(min_trials=1):
+                if done >= trials:
+                    tuner.cancel(tr)
+                    continue
+                q = float(obj([tr.config])[0])
+                starts0 = sm.refits_started
+                t0 = time.perf_counter()
+                stats = tuner.tell(tr, q)
+                dt = time.perf_counter() - t0
+                lats.append(dt * 1e3)
+                blocked.append(
+                    stats.t_refit * 1e3 if stats is not None else 0.0)
+                w = sm.refits_started > starts0
+                windows.append(w)
+                if w:
+                    # a window is WARM once the bucket this fit
+                    # compiles for has been fitted before: first-use
+                    # windows pay trace+compile in both modes
+                    # (unhideable Python tracing) and are reported
+                    # separately as cold_window_p95
+                    bkt = sm.fit_bucket()
+                    warm.append(bkt in seen_buckets)
+                    seen_buckets.add(bkt)
+                else:
+                    warm.append(False)
+                done += 1
+        res = tuner.result()
+        out = {
+            "tells": done,
+            "refit_windows": int(sum(windows)),
+            "warm_refit_windows": int(sum(warm)),
+            "t_refit_blocking_s": round(res.t_refit, 4),
+            "t_refit_bg_s": round(sm.t_refit_bg_total, 4),
+            "full_fits_published": sm.refits,
+            "incremental_updates": sm.incr_updates,
+            "final_snapshot_version": sm.snapshot_version,
+            "refit_lag_rows_final": sm.refit_lag_rows,
+        }
+        tuner.close()   # drains the background worker
+        wl = [l for l, w in zip(lats, warm) if w]
+        bl = [b for b, w in zip(blocked, warm) if w]
+        cl = [l for l, w, ww in zip(lats, windows, warm) if w and not ww]
+        sl = [l for l, w in zip(lats, windows) if not w]
+        pct = (lambda a, p: round(float(np.percentile(a, p)), 3)
+               if len(a) else None)
+        out["tell_ms"] = {
+            "p50": pct(lats, 50), "p95": pct(lats, 95),
+            "refit_window_p50": pct(wl, 50),
+            "refit_window_p95": pct(wl, 95),
+            "cold_window_p95": pct(cl, 95),
+            "steady_p50": pct(sl, 50), "steady_p95": pct(sl, 95),
+        }
+        # the learning-ATTRIBUTABLE component of those window tells
+        # (StepStats.t_refit: seconds the finalize blocked inside
+        # observe->maybe_refit) — immune to the scheduler noise a
+        # shared 2-core box injects into whole-tell percentiles
+        out["refit_blocked_ms"] = {"warm_window_p50": pct(bl, 50),
+                                   "warm_window_p95": pct(bl, 95)}
+        return out
+
+    # warmup pass (unguarded, discarded): populates the persistent
+    # compile cache with every driver/fit/extension program the
+    # measured runs will use, so their latencies reflect the steady
+    # state a long tune lives in (~fast cache loads instead of
+    # multi-second XLA compiles) — the same philosophy as the driver
+    # bench's 200 warm trials.  Tracing still happens live in the
+    # guarded runs, so the strict retrace report keeps its teeth.
+    lat_run(False)
+
+    with guard_from_env() as guard_sync:
+        sync = lat_run(False)
+    with guard_from_env() as guard_async:
+        asyn = lat_run(True)
+
+    # protocol B: iterations-to-optimum spot check (BENCHREPORT
+    # thresholds: 2d <= 0.1 within 2000, 4d <= 1.0 within 4000)
+    def iters_run(dims, thresh, budget, seed, async_on):
+        sp = rosenbrock_space(dims, -2.048, 2.048)
+        t = Tuner(sp, rosenbrock_objective(dims), seed=seed,
+                  surrogate="gp",
+                  surrogate_opts={**CALIBRATED_OPTS,
+                                  "async_refit": async_on})
+        res = t.run(test_limit=budget, target=thresh)
+        t.close()
+        for i, v in enumerate(res.trace):
+            if v <= thresh:
+                return i + 1
+        return budget
+
+    # --quick is the tier-1 smoke: latency protocol only (the
+    # spot-check's repeated full tunes belong to the committed full
+    # artifact, not the suite budget)
+    problems = [] if quick else [(2, 0.1, 2000), (4, 1.0, 4000)]
+    seeds = range(5)
+    spot = {}
+    for dims, thresh, budget in problems:
+        cell = {}
+        for mode, async_on in (("sync", False), ("async", True)):
+            its = [iters_run(dims, thresh, budget, s, async_on)
+                   for s in seeds]
+            q1, med, q3 = (float(np.percentile(its, p))
+                           for p in (25, 50, 75))
+            cell[mode] = {"iters": its, "median": med,
+                          "iqr": [q1, q3],
+                          "censored": int(sum(i >= budget for i in its))}
+        cell["async_median_within_sync_iqr"] = bool(
+            cell["sync"]["iqr"][0] <= cell["async"]["median"]
+            <= cell["sync"]["iqr"][1]) or (
+            cell["async"]["median"] <= cell["sync"]["median"])
+        spot[f"rosenbrock-{dims}d"] = cell
+
+    sp95 = sync["refit_blocked_ms"]["warm_window_p95"]
+    ap95 = asyn["refit_blocked_ms"]["warm_window_p95"]
+    speedup = round(sp95 / ap95, 2) if sp95 and ap95 else None
+    st95 = sync["tell_ms"]["refit_window_p95"]
+    at95 = asyn["tell_ms"]["refit_window_p95"]
+    bg = asyn["t_refit_bg_s"]
+    blocking = asyn["t_refit_blocking_s"]
+    result = {
+        "metric": "surrogate_async_refit_window_p95_speedup",
+        # headline: sync/async ratio of the LEARNING-ATTRIBUTABLE tell
+        # p95 inside warm refit windows (StepStats.t_refit).  The
+        # whole-tell window percentiles are reported alongside
+        # (tell_window_p95_ratio) — on a shared 2-core box they carry
+        # scheduler-noise outliers an async run has few windows to
+        # amortize over
+        "value": speedup,
+        "tell_window_p95_ratio": (round(st95 / at95, 2)
+                                  if st95 and at95 else None),
+        "unit": "sync/async ratio of learning-attributable tell p95 "
+                "(StepStats.t_refit) during warm refit windows",
+        "platform": "cpu",
+        "quick": quick,
+        "nproc": os.cpu_count(),
+        "protocol": {
+            "space": "rosenbrock-2d", "seed": 0, "tells": trials,
+            "surrogate": sopts,
+            "devices": "2 virtual CPU devices: driver plane on 0, "
+                       "background fits on 1 (the async deployment "
+                       "shape; one device serializes fit vs driver "
+                       "dispatches)",
+            "lockstep": "ask(min_trials=1)/tell, matched seeds; refit "
+                        "windows = tells whose finalize launched/ran a "
+                        "full fit; a window is WARM once its bucket "
+                        "was fitted before (first-use windows pay "
+                        "unhideable Python tracing in both modes and "
+                        "are reported as cold_window_p95)",
+        },
+        "sync": sync,
+        "async": asyn,
+        # fraction of full-fit compute the async plane moved OFF the
+        # tell path (1.0 = everything overlapped with foreground work)
+        "refit_overlap_fraction": round(bg / (bg + blocking), 4)
+        if bg + blocking > 0 else None,
+        "iters_to_optimum_spotcheck": spot,
+    }
+    if guard_sync.enabled:
+        result["retraces"] = {"sync": guard_sync.report(),
+                              "async": guard_async.report()}
+    name = ("BENCH_SURROGATE.quick.json" if quick
+            else "BENCH_SURROGATE.json")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump({**result, "captured_unix": time.time()}, f, indent=1)
+    print(f"bench: async-surrogate evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps(result))
+
+
 def main() -> None:
     if "--driver" in sys.argv:
         driver_main()
         return
     if "--cache" in sys.argv:
         cache_main()
+        return
+    if "--surrogate" in sys.argv:
+        surrogate_main()
         return
     quick = "--quick" in sys.argv
     jax, platform = _init_backend(

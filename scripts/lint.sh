@@ -24,22 +24,27 @@ if [ -f scripts/lint_baseline.json ]; then
 fi
 "${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"
 
-# uptune_tpu/store/ must stay SUPPRESSION-FREE on top of clean: cache-
-# correctness code (what decides whether a build is skipped) gets no
-# '# ut-lint: disable' escape hatch and no baseline (ISSUE 4 satellite)
+# uptune_tpu/store/ and uptune_tpu/surrogate/ must stay
+# SUPPRESSION-FREE on top of clean: cache-correctness code (what
+# decides whether a build is skipped, ISSUE 4) and the concurrent
+# background-refit plane (ISSUE 5) get no '# ut-lint: disable' escape
+# hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys
-r = subprocess.run(
-    [sys.executable, "-m", "uptune_tpu.analysis", "uptune_tpu/store",
-     "--format", "json", "--show-suppressed"],
-    capture_output=True, text=True)
-doc = json.loads(r.stdout)
-if doc["findings"]:
-    print("ut-lint: uptune_tpu/store/ must be finding- AND "
-          "suppression-free:", file=sys.stderr)
-    for f in doc["findings"]:
-        print(f"  {f['path']}:{f['line']} {f['rule']} "
-              f"(suppressed={f.get('suppressed', False)})",
-              file=sys.stderr)
-    sys.exit(1)
+rc = 0
+for pkg in ("uptune_tpu/store", "uptune_tpu/surrogate"):
+    r = subprocess.run(
+        [sys.executable, "-m", "uptune_tpu.analysis", pkg,
+         "--format", "json", "--show-suppressed"],
+        capture_output=True, text=True)
+    doc = json.loads(r.stdout)
+    if doc["findings"]:
+        print(f"ut-lint: {pkg}/ must be finding- AND "
+              f"suppression-free:", file=sys.stderr)
+        for f in doc["findings"]:
+            print(f"  {f['path']}:{f['line']} {f['rule']} "
+                  f"(suppressed={f.get('suppressed', False)})",
+                  file=sys.stderr)
+        rc = 1
+sys.exit(rc)
 EOF

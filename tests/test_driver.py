@@ -412,7 +412,11 @@ class TestHistoryMergeInsert:
         assert dropped == 8  # 16 live rows pushed through 8 slots
         self._check(h, st, live, dropped)
 
+    @pytest.mark.slow
     def test_fuzz_against_model(self):
+        # ~20s randomized sweep over the same regimes the deterministic
+        # siblings above pin individually — slow-marked for tier-1
+        # headroom (ISSUE 5); the targeted cases stay in every run
         import numpy as np
         rng = np.random.RandomState(42)
         for cap in (8, 32):

@@ -169,7 +169,11 @@ class TestShardedSemanticEquivalence:
             arms.append(PureRandom(batch=pad))
         return FusedEngine(space, obj, arms=arms)
 
+    @pytest.mark.slow
     def test_trajectory_equivalence_60_steps(self):
+        # ~10s; slow-marked for tier-1 headroom (ISSUE 5).  The gate
+        # itself stays tier-1 through the perm-space sibling below and
+        # the driver's separate __graft_entry__.dryrun_multichip run
         space = rosenbrock_space(3, -3.0, 3.0)
         eng = self._padded_engine(space, _rb_obj)  # dedup ON (default)
         key = jax.random.PRNGKey(11)

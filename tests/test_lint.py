@@ -619,14 +619,18 @@ class TestTraceGuard:
 
 
 # ------------------------------------------------------- repo gate
-def test_store_package_suppression_free():
-    """The results-store package (what decides whether a build is
-    SKIPPED — cache correctness) must be finding- AND suppression-free:
-    no '# ut-lint: disable' escape hatch, no baseline.  lint.sh
-    enforces the same in the pre-commit gate."""
+@pytest.mark.parametrize("package", ["store", "surrogate"])
+def test_package_suppression_free(package):
+    """Packages on the correctness-critical fast path must be finding-
+    AND suppression-free: no '# ut-lint: disable' escape hatch, no
+    baseline.  store/ decides whether a build is SKIPPED (cache
+    correctness, ISSUE 4); surrogate/ now runs a concurrent background
+    refit thread (ISSUE 5) — a silenced host-sync or retrace hazard
+    there would hide a stall on the very path this PR moved off the
+    driver.  lint.sh enforces the same in the pre-commit gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",
-         os.path.join(REPO, "uptune_tpu", "store"),
+         os.path.join(REPO, "uptune_tpu", package),
          "--format", "json", "--show-suppressed"],
         capture_output=True, text=True, cwd=REPO,
         env={**os.environ, "PYTHONPATH": REPO})

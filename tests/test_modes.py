@@ -180,7 +180,11 @@ class TestCLI:
         try:
             pt = ProgramTuner(["true"], str(tmp_path))
             assert pt.surrogate == "gp"
-            assert pt.surrogate_opts == CALIBRATED_OPTS
+            # calibrated defaults plus the async surrogate plane, ON by
+            # default in program mode (docs/PERF.md; --surrogate-async
+            # off / ut.config {'surrogate-async': 'off'} restore sync)
+            assert pt.surrogate_opts == {**CALIBRATED_OPTS,
+                                         "async_refit": True}
             # explicit surrogate still wins over the setting
             pt2 = ProgramTuner(["true"], str(tmp_path),
                                surrogate="mlp",

@@ -30,7 +30,14 @@ def results():
     return run(n=400, n_test=200, quick=False)
 
 
+@pytest.mark.slow
 class TestParity:
+    """Ranking-quality parity vs the 300-tree oracle: a ~34s
+    module-fixture benchmark (the reference GBT fit dominates), slow-
+    marked with the other convergence/bench gates (ISSUE 5 tier-1
+    headroom); the cheap TestMLL/TestKernelNumerics/TestMaskedFit
+    correctness checks below stay tier-1."""
+
     def test_gp_mll_beats_tree_oracle(self, results):
         """The headline: marginal-likelihood-fitted GP must be within
         0.05 Spearman of the tree oracle (measured: GP 0.89 vs GBT

@@ -131,6 +131,13 @@ class TraceGuard:
         if not self.enabled:
             return self
         import jax
+        # pre-load the lazily-imported jax.scipy submodule: its module
+        # body builds internal shape-polymorphic jit wrappers
+        # (_cho_solve, _solve_triangular) that would otherwise be
+        # created — and counted — inside the guard the first time a
+        # guarded region imports the surrogate stack.  The guard
+        # measures THIS repo's programs, not jax library internals
+        import jax.scipy.linalg  # noqa: F401
         self._jax = jax
         self._orig_jit = jax.jit
         jax.jit = self._counting_jit

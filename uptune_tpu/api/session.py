@@ -53,6 +53,14 @@ DEFAULTS: Dict[str, Any] = {
     # same (space, program): preload best-so-far + dedup history +
     # surrogate training set before the first acquisition
     "warm-start": False,
+    # async surrogate plane (docs/PERF.md): 'on' (None = default) moves
+    # the O(N^3) GP refit + fit_auto hyperparameter sweep onto a
+    # background worker publishing versioned snapshots, so the driver
+    # tell path never blocks on learning; 'off' runs the full refit
+    # synchronously inline again (note: O(N^2) incremental extension
+    # between refits stays on in both modes — disable it via
+    # surrogate_opts={'incremental': False})
+    "surrogate-async": None,
 }
 
 settings: Dict[str, Any] = dict(DEFAULTS)

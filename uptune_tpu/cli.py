@@ -64,6 +64,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "+ affordable 8-eval pulls, no passivation — "
                         "0.88x baseline on gcc-real at 30 seeds, "
                         "BENCHREPORT.md)")
+    p.add_argument("--surrogate-async", choices=("on", "off"),
+                   default=None,
+                   help="async surrogate plane (default on): 'on' runs "
+                        "the O(N^3) GP refit + hyperparameter sweep on "
+                        "a background worker publishing versioned "
+                        "snapshots — ask/tell never blocks on learning "
+                        "and new observations fold into the model via "
+                        "O(N^2) incremental Cholesky updates; 'off' "
+                        "restores the synchronous inline refit")
     p.add_argument("--surrogate-screen", action="append", default=None,
                    metavar="ARCHIVE",
                    help="cross-payload transfer: driver jsonl trial "
@@ -439,7 +448,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         runtime_limit=args.runtime_limit, timeout=args.timeout,
         technique=technique, seed=args.seed, params_file=args.params,
         resume=args.resume, sandbox=not args.no_sandbox,
-        surrogate=surrogate, surrogate_opts=sopts, template=template,
+        surrogate=surrogate, surrogate_opts=sopts,
+        surrogate_async=args.surrogate_async, template=template,
         seed_configs=seed_cfgs, prefetch=args.prefetch,
         compile_cache_dir=args.compile_cache_dir,
         store_dir=store_dir, warm_start=args.warm_start)

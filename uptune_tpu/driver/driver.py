@@ -19,6 +19,7 @@ Host-side replacement for the reference's controller + search-driver pair
 from __future__ import annotations
 
 import json
+import logging
 import math
 import os
 import time
@@ -36,6 +37,8 @@ from .history import History, dup_source
 from .plugins import fire as _fire
 
 Objective = Callable[[List[Dict[str, Any]]], Sequence[float]]
+
+log = logging.getLogger("uptune_tpu")
 
 
 def _leaf_keys(tree):
@@ -87,6 +90,13 @@ class StepStats(NamedTuple):
     t_propose: float = 0.0
     t_dedup: float = 0.0
     t_eval_wait: float = 0.0
+    # surrogate-plane observability for this ticket: seconds the tell
+    # path BLOCKED on surrogate learning (sync full fits + incremental
+    # extensions; ~0 under async refit), the snapshot version scoring
+    # currently reads, and its staleness in training rows
+    t_refit: float = 0.0
+    snapshot_version: int = 0
+    refit_lag_rows: int = 0
 
 
 class Trial:
@@ -166,6 +176,9 @@ class TuneResult(NamedTuple):
     t_propose: float = 0.0
     t_dedup: float = 0.0
     t_eval_wait: float = 0.0
+    # cumulative seconds the driver hot path spent BLOCKED on surrogate
+    # learning (sync refits; ~0 with the async surrogate plane)
+    t_refit: float = 0.0
 
 
 class Tuner:
@@ -391,6 +404,7 @@ class Tuner:
         self.t_propose_total = 0.0
         self.t_dedup_total = 0.0
         self.t_eval_wait_total = 0.0
+        self.t_refit_total = 0.0
 
         if resume and archive and os.path.exists(archive):
             self._resume(archive)
@@ -505,8 +519,25 @@ class Tuner:
             # replayed trials are training data too: without this the
             # surrogate restarts cold after every resume while the
             # techniques resume warm (reference resume() replays into
-            # the DBs its surrogate trains from, api.py:341-363)
-            self.surrogate.maybe_refit()
+            # the DBs its surrogate trains from, api.py:341-363).
+            # Routed through the async plane when enabled (the fit runs
+            # on the background worker and startup proceeds); a sync
+            # fit over a large archive blocks HERE, so it is logged
+            # rather than stalling silently (ISSUE 5 satellite)
+            r0 = time.perf_counter()
+            fitted = self.surrogate.maybe_refit()
+            dt = time.perf_counter() - r0
+            if getattr(self.surrogate, "_refit_future", None) \
+                    is not None:
+                log.info("[ut] resume: surrogate refit over %d replayed "
+                         "rows scheduled on the background worker "
+                         "(t_refit=%.3fs on the startup path)",
+                         len(rows), dt)
+            elif dt > 0.1 or fitted:
+                log.info("[ut] resume: surrogate refit over %d replayed "
+                         "rows took t_refit=%.3fs (enable the async "
+                         "surrogate plane to move this off the startup "
+                         "path)", len(rows), dt)
         self.gid = max(int(r["gid"]) for r in rows) + 1
         self.evals = len(rows) + compacted
         self.told = len(rows) + compacted
@@ -990,12 +1021,6 @@ class Tuner:
         # event is the load-bearing negative feedback that lets the
         # bandit starve a saturated arm.
         withdrawn = bool(tk.trials) and not live
-        if evaluated and self.surrogate is not None:
-            idx = jnp.asarray([tr.row for tr in live])
-            self.surrogate.observe(
-                np.asarray(self.space.features(tk.cands[idx])),
-                qor_np[np.asarray(idx)])
-            self.surrogate.maybe_refit()
 
         prev = float(self.best.qor)
         qor = None
@@ -1066,6 +1091,25 @@ class Tuner:
             self._credit(tk.arm_name, was_new_best, live, new)
         if was_new_best:
             self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])[2] += 1
+        t_refit = 0.0
+        if evaluated and self.surrogate is not None:
+            # surrogate learning is the LAST act of the ticket, after
+            # every driver device dispatch (_commit, arm observe): an
+            # async submission starts the background fit immediately,
+            # and a device op issued after it would queue behind the
+            # fit's execution on the shared CPU threadpool — ordered
+            # this way the tell returns with nothing left to wait on,
+            # and the fit overlaps the next build window.  Sync mode
+            # pays the full O(N^3) fit inline here; async submits and
+            # folds fresh rows in via O(N^2) incremental extension, so
+            # t_refit stays ~0 on the tell path.
+            idx = jnp.asarray([tr.row for tr in live])
+            self.surrogate.observe(
+                np.asarray(self.space.features(tk.cands[idx])),
+                qor_np[np.asarray(idx)])
+            r0 = time.perf_counter()
+            self.surrogate.maybe_refit()
+            t_refit = time.perf_counter() - r0
         dropped = self._last_dropped
         if dropped and not self._cap_warned:
             self._cap_warned = True
@@ -1081,10 +1125,14 @@ class Tuner:
         self.t_propose_total += tk.t_propose
         self.t_dedup_total += tk.t_dedup
         self.t_eval_wait_total += t_wait
+        self.t_refit_total += t_refit
+        sm = self.surrogate
         stats = StepStats(self.steps, tk.arm_name, tk.cands.batch,
                           evaluated, self.sign * new, was_new_best,
                           tk.pruned, dropped, tk.t_propose, tk.t_dedup,
-                          t_wait)
+                          t_wait, t_refit,
+                          int(getattr(sm, "snapshot_version", 0) or 0),
+                          int(getattr(sm, "refit_lag_rows", 0) or 0))
         if self.hooks:
             if was_new_best:
                 res = self.result()
@@ -1263,7 +1311,8 @@ class Tuner:
             cfg = self.space.to_configs(self.best.as_batch(1))[0]
         return TuneResult(cfg, self.sign * q, self.evals, self.steps,
                           list(self.trace), self.t_propose_total,
-                          self.t_dedup_total, self.t_eval_wait_total)
+                          self.t_dedup_total, self.t_eval_wait_total,
+                          self.t_refit_total)
 
     def best_config(self) -> Dict[str, Any]:
         return self.result().best_config
@@ -1272,6 +1321,14 @@ class Tuner:
         if self.hooks:
             _fire(self.hooks, "on_finish", self, self.result())
             self.hooks = []
+        sm = self.surrogate
+        if sm is not None:
+            # let an in-flight background refit publish and shut the
+            # worker down so no refit thread outlives the run
+            if hasattr(sm, "close"):
+                sm.close()
+            elif hasattr(sm, "drain"):
+                sm.drain()
         if self._archive_f is not None:
             self._archive_f.close()
             self._archive_f = None

@@ -65,6 +65,7 @@ class ProgramTuner:
                  params_file: Optional[str] = None,
                  archive: Optional[str] = None, resume: bool = False,
                  surrogate=None, surrogate_opts: Optional[dict] = None,
+                 surrogate_async: Optional[str] = None,
                  env: Optional[Dict[str, str]] = None,
                  sandbox: bool = True,
                  status_interval: Optional[int] = None,
@@ -144,6 +145,20 @@ class ProgramTuner:
             from ..calibrated import CALIBRATED_OPTS
             self.surrogate_opts = {**CALIBRATED_OPTS,
                                    **(surrogate_opts or {})}
+            # async surrogate plane (docs/PERF.md): flag > ut.config >
+            # default ON for program mode — builds give the background
+            # refit wall-clock to hide behind, exactly like prefetch.
+            # An explicit surrogate_opts['async_refit'] (library use)
+            # wins over the settings default; the explicit
+            # --surrogate-async flag wins over everything
+            sa = (surrogate_async if surrogate_async is not None
+                  else settings["surrogate-async"])
+            on = str(sa).lower() not in ("off", "false", "0") \
+                if sa is not None else True
+            if surrogate_async is not None:
+                self.surrogate_opts["async_refit"] = on
+            else:
+                self.surrogate_opts.setdefault("async_refit", on)
         else:
             self.surrogate_opts = surrogate_opts
             if surrogate is None and surrogate_opts:

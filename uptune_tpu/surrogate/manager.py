@@ -14,7 +14,9 @@ same anti-myopia role, multi_stage.py:109-117).
 """
 from __future__ import annotations
 
-from typing import Optional
+import threading
+import time
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +28,29 @@ from . import mlp as mlp_mod
 from . import pallas_score
 
 KINDS = ("gp", "mlp")
+
+
+class SurrogateSnapshot(NamedTuple):
+    """One immutable published model state.  Everything scoring reads —
+    the fitted state, the prune threshold, the incumbent — travels
+    together, so a reader that grabbed `manager._snap` once can never
+    observe a half-updated model: publication is a single reference
+    rebind (atomic under the GIL) of a fully-built snapshot.
+
+    `version` is the monotonic publication counter (full refits AND
+    incremental extensions bump it); `n_rows` is the train-row
+    watermark — observations [0, n_rows) of the manager's training set
+    are conditioned into `state`.  `exact` marks that those rows occupy
+    the padded bucket verbatim in training order (no best-biased
+    subsample ran), which is what makes O(N^2) rank-1 extension of row
+    `in_bucket` valid between full refits."""
+    state: Any
+    version: int
+    n_rows: int
+    threshold: Optional[float]
+    best_y: Optional[float]
+    exact: bool = True
+    in_bucket: int = 0
 
 
 def _screen_feats(feats, sidx, sw):
@@ -60,7 +85,8 @@ class SurrogateManager:
                  arbitration: str = "schedule",
                  propose_batch_parity: bool = True,
                  screen=None, screen_mode: str = "hard",
-                 flip_bias: str = "none"):
+                 flip_bias: str = "none",
+                 async_refit: bool = False, incremental: bool = True):
         if kind not in KINDS:
             raise ValueError(f"unknown surrogate {kind!r}; known: {KINDS}")
         if arbitration not in ("schedule", "bandit"):
@@ -123,10 +149,60 @@ class SurrogateManager:
         self.n_members = n_members
         self._xs: list = []
         self._ys: list = []
-        self._state = None
         self._since_fit = 0
         self._key = jax.random.PRNGKey(seed)
-        self._threshold = None
+
+        # --- versioned snapshot plane (docs/PERF.md "Async surrogate
+        # plane").  Scoring paths read `self._snap` exactly once per
+        # call; learning publishes whole SurrogateSnapshot objects by
+        # rebinding it under `_pub_lock` (the lock orders concurrent
+        # publishers — the background refit worker vs the driver
+        # thread's incremental extensions — readers stay lock-free).
+        #
+        # async_refit=True moves the O(N^3) full fit (and the fit_auto
+        # hyperparameter sweep) onto a single background worker thread:
+        # maybe_refit() SUBMITS at the cadence and returns immediately,
+        # the worker publishes when ready, and the driver tell path
+        # never blocks on learning.  Donation/dispatch stays on the
+        # refit thread (JAX dispatch is thread-safe).  force_refit() is
+        # forced-sync in both modes — warm-start/preload callers (PR 4)
+        # rely on guidance from the very next acquisition.
+        #
+        # incremental=True keeps the published model FRESH between full
+        # refits: each new observation extends the cached Cholesky
+        # factor in O(N^2) inside the padded bucket (gp.extend), with
+        # full fit_auto hyperparameter re-selection demoted to the
+        # refit_interval cadence.
+        self.async_refit = bool(async_refit)
+        self.incremental = bool(incremental)
+        # rank-1 extensions folded per maybe_refit tick: each row is
+        # one O(N^2) jitted dispatch (~ms), and a backlog accumulated
+        # while a background fit ran would otherwise land on a single
+        # tell — the cap amortizes it across ticks at a bounded per-tell
+        # cost; the cadence-driven full refit clears any residual lag
+        self._ext_per_tick = 8
+        # a single device SERIALIZES executions: a background fit
+        # running on the driver's device would make every driver
+        # dispatch queue behind it — overlap in wall-clock but not on
+        # the device.  With >1 local device the fit plane claims the
+        # LAST one (driver programs live on device 0) and the published
+        # state is copied back to device 0, so scoring never crosses
+        # devices; on a 1-device platform fits share the device and the
+        # async win reduces to hiding fits behind host/build time
+        devs = jax.local_devices()
+        self._refit_device = (devs[-1] if self.async_refit
+                              and len(devs) > 1 else None)
+        self._snap: Optional[SurrogateSnapshot] = None
+        self._pub_lock = threading.Lock()
+        self._version = 0
+        self._refit_exec = None       # lazy single-worker executor
+        self._refit_future = None
+        self.refits_started = 0       # full fits launched (sync + bg)
+        self.refits = 0               # full fits published
+        self.incr_updates = 0         # rank-1 extensions applied
+        self.t_refit_last = 0.0       # s of the last BLOCKING full fit
+        self.t_refit_total = 0.0      # cumulative blocking-fit seconds
+        self.t_refit_bg_total = 0.0   # cumulative background-fit seconds
 
         # surrogate feature representation (Space.surrogate_transform):
         # numeric lanes snapped to their decoded grid, categorical lanes
@@ -223,22 +299,57 @@ class SurrogateManager:
         self.auto_passive = auto_passive
         self.passive = False
 
-        self._best_y = None  # min finite observed y (engine orientation)
+        # The training bucket grows with N (powers of two up to
+        # max_points), and every program whose input carries the padded
+        # training state re-traces at each new bucket.  That is the
+        # DESIGN (one compile per bucket, never one per N) — but a
+        # single shape-polymorphic wrapper would read as retrace churn
+        # to a TraceGuard, and lazily building wrappers after their
+        # code object traced counts as rebuild churn.  So every
+        # bucket-shaped program gets a per-bucket wrapper FLEET, built
+        # up-front: each wrapper traces exactly once and
+        # UT_TRACE_GUARD=strict stays clean over a whole tune (the
+        # bucketed-fit_auto half of ISSUE 5; gp.fit_auto_bucketed is
+        # the same idea for standalone callers).
+        buckets, b = {self.max_points}, 1
+        while b < self.max_points:
+            buckets.add(b)
+            b *= 2
+        self._buckets = sorted(buckets)
+        self._ext_jit: dict = {}
         if kind == "gp":
             nc, ncat = self._n_cont, self._n_cat
             if hyper_fit:
-                self._fit = jax.jit(lambda x, y, mask: gp_mod.fit_auto(
-                    x, y, mask, n_cont=nc, n_cat=ncat))
+                self._fit_jit = {
+                    bb: jax.jit(lambda x, y, mask: gp_mod.fit_auto(
+                        x, y, mask, n_cont=nc, n_cat=ncat))
+                    for bb in self._buckets}
             else:
-                self._fit = jax.jit(lambda x, y, mask: gp_mod.fit(
-                    x, y, mask=mask, n_cont=nc, n_cat=ncat))
-            self._score = jax.jit(lambda st, xq: gp_mod.lower_confidence_bound(
-                st, xq, n_cont=nc, n_cat=ncat))
-            self._score_ei = jax.jit(lambda st, xq, b: gp_mod.expected_improvement(
-                st, xq, b, n_cont=nc, n_cat=ncat))
+                self._fit_jit = {
+                    bb: jax.jit(lambda x, y, mask: gp_mod.fit(
+                        x, y, mask=mask, n_cont=nc, n_cat=ncat))
+                    for bb in self._buckets}
+            self._score_jit = {
+                bb: jax.jit(lambda st, xq: gp_mod.lower_confidence_bound(
+                    st, xq, n_cont=nc, n_cat=ncat))
+                for bb in self._buckets}
+            self._score_ei_jit = {
+                bb: jax.jit(lambda st, xq, b: gp_mod.expected_improvement(
+                    st, xq, b, n_cont=nc, n_cat=ncat))
+                for bb in self._buckets}
+            if self.incremental:
+                self._ext_jit = {
+                    bb: jax.jit(lambda st, xr, yr, sl: gp_mod.extend(
+                        st, xr, yr, sl, n_cont=nc, n_cat=ncat))
+                    for bb in self._buckets}
         else:
-            self._fit = jax.jit(lambda k, x, y, mask: mlp_mod.fit(
-                k, x, y, n_members=n_members, mask=mask))
+            # the mlp ensemble's PARAMS are bucket-independent (only
+            # training consumes the padded set), so scoring keeps one
+            # wrapper; the fit still gets a per-bucket fleet
+            self._fit_jit = {
+                bb: jax.jit(lambda k, x, y, mask: mlp_mod.fit(
+                    k, x, y, n_members=n_members, mask=mask))
+                for bb in self._buckets}
             self._score = jax.jit(mlp_mod.predict_members)
 
     # ------------------------------------------------------------------
@@ -255,7 +366,51 @@ class SurrogateManager:
 
     @property
     def fitted(self) -> bool:
-        return self._state is not None
+        return self._snap is not None
+
+    # legacy accessors: the pre-snapshot attributes, now views of the
+    # published snapshot (tests and downstream tooling read _state)
+    @property
+    def _state(self):
+        s = self._snap
+        return None if s is None else s.state
+
+    @property
+    def _threshold(self) -> Optional[float]:
+        s = self._snap
+        return None if s is None else s.threshold
+
+    @property
+    def _best_y(self) -> Optional[float]:
+        s = self._snap
+        return None if s is None else s.best_y
+
+    @property
+    def _use_kinv(self) -> bool:
+        """Attach the premasked K^-1 at publish iff pools are large
+        enough for the fused Pallas variance path (r5 review: once per
+        refit, never per scoring call).  Evaluated per fit because the
+        driver's bandit pull-size parity may raise propose_batch after
+        construction (before the first fit, so the published pytree
+        structure stays stable across a run)."""
+        return (self.kind == "gp" and self.propose_batch
+                * self.pool_mult >= pallas_score.PALLAS_MIN_POOL)
+
+    @property
+    def snapshot_version(self) -> int:
+        """Monotonic publication counter (0 = never fitted)."""
+        s = self._snap
+        return 0 if s is None else s.version
+
+    @property
+    def refit_lag_rows(self) -> int:
+        """Staleness bound: observed training rows the published
+        snapshot has not conditioned on yet (= n_points when unfitted).
+        Bounded by refit_interval + the rows observed while one
+        background fit runs; 0 whenever incremental extension keeps
+        up."""
+        s = self._snap
+        return self.n_points - (0 if s is None else s.n_rows)
 
     def observe(self, feats: np.ndarray, qor: np.ndarray) -> None:
         """Record evaluated (features, engine-oriented QoR) rows.
@@ -269,49 +424,138 @@ class SurrogateManager:
             self._since_fit += 1
 
     def maybe_refit(self) -> bool:
-        if self.n_points < self.min_points:
-            return False
-        if self.fitted and self._since_fit < self.refit_interval:
-            return False
-        xs_np = np.stack(self._xs)
-        x = jnp.asarray(xs_np)
-        y = jnp.asarray(np.asarray(self._ys, np.float32))
-        self._key, ks, kf = jax.random.split(self._key, 3)
-        x, y = gp_mod.subsample(ks, x, y, self.max_points)
-        # pad to the next power-of-two bucket so the jitted fit compiles
-        # once per bucket instead of once per growing N (ADVICE round 1:
-        # every refit below max_points re-traced the O(N^3) program)
-        n = x.shape[0]
-        bucket = 1
-        while bucket < n:
-            bucket *= 2
-        bucket = min(bucket, max(self.max_points, n))
-        mask = jnp.concatenate(
-            [jnp.ones(n), jnp.zeros(bucket - n)]).astype(x.dtype)
-        x = jnp.concatenate([x, jnp.zeros((bucket - n, x.shape[1]),
-                                          x.dtype)])
-        y = jnp.concatenate([y, jnp.zeros(bucket - n, y.dtype)])
-        if self.kind == "gp":
-            self._state = self._fit(x, y, mask)
-            if (self.propose_batch * self.pool_mult
-                    >= pallas_score.PALLAS_MIN_POOL):
-                # large pools score through the fused Pallas variance
-                # path; attach the premasked K^-1 ONCE per refit rather
-                # than once per pool pull (r5 review)
-                self._state = gp_mod.precompute_kinv(self._state)
-        else:
-            self._state = self._fit(kf, x, y, mask)
-        finite = [v for v in self._ys if np.isfinite(v)]
-        self._threshold = float(
-            np.quantile(finite, self.keep_quantile)) if finite else None
-        self._best_y = float(np.min(finite)) if finite else None
+        """Advance the learning plane one tick.  Sync mode: run the full
+        fit inline when the cadence is due (the pre-PR-5 behavior).
+        Async mode: SUBMIT the full fit to the background worker and
+        return immediately — the worker publishes the snapshot when
+        ready.  In both modes, observations past the published
+        watermark are folded in via O(N^2) incremental Cholesky
+        extension (gp.extend) so scoring stays fresh between full fits.
+        Returns True iff a full fit was PUBLISHED during this call."""
+        published = self._poll_refit()
+        if self.n_points >= self.min_points:
+            due = self._refit_future is None and (
+                not self.fitted or self._since_fit >= self.refit_interval)
+            if due:
+                args = self._refit_args()
+                if self.async_refit:
+                    if self._refit_exec is None:
+                        from concurrent.futures import ThreadPoolExecutor
+                        self._refit_exec = ThreadPoolExecutor(
+                            max_workers=1,
+                            thread_name_prefix="ut-surrogate-refit")
+                    self._refit_future = self._refit_exec.submit(
+                        self._refit_full, *args, background=True)
+                else:
+                    self._refit_full(*args)
+                    published = True
+        if self.fitted and not published and self._refit_future is None:
+            # no extension while a fit is in flight: the submitted fit
+            # already covers those rows (marginal freshness), and even
+            # on a dedicated refit device the CPU execution threadpool
+            # is shared — measured ~30-100 ms/row queueing behind the
+            # running fit, exactly the tell-path stall the plane
+            # removes.  Post-submission rows fold in (capped per tick)
+            # from the tick after publish.
+            self._maybe_extend()
+        return published
+
+    def _refit_args(self):
+        """Snapshot the training set + keys on the CALLER's thread so a
+        background fit sees a frozen watermark (rows observed after
+        submission belong to the next fit / the incremental path) and
+        the key stream stays identical between sync and async modes."""
+        self.refits_started += 1
         self._since_fit = 0
+        self._key, ks, kf = jax.random.split(self._key, 3)
+        return (np.stack(self._xs),
+                np.asarray(self._ys, np.float32), ks, kf)
+
+    def fit_bucket(self, n: Optional[int] = None) -> int:
+        """The padded training bucket a full fit over `n` rows (default:
+        the current training set) compiles for: power-of-two, capped at
+        max_points, with one refit_interval of padding headroom
+        reserved so incremental extension has slots to fold new rows
+        into even when n lands exactly on a power of two."""
+        n = min(self.n_points if n is None else n, self.max_points)
+        headroom = (self.refit_interval
+                    if self.incremental and self.kind == "gp" else 0)
+        target = min(n + headroom, max(self.max_points, n))
+        return gp_mod.bucket_of(target, self.max_points)
+
+    @staticmethod
+    def _host_subsample(xs_np, ys_np, ks, max_points):
+        """gp.subsample's best-biased draw, in HOST numpy: keep the best
+        half deterministically, fill the rest at random (seeded off the
+        fit key).  On host because it runs before every full fit with a
+        DIFFERENT n — the device version's internal ops would re-trace
+        per n on the refit worker, and that Python-heavy tracing holds
+        the GIL against the driver thread (the stall the async plane
+        exists to remove)."""
+        n = len(ys_np)
+        if n <= max_points:
+            return xs_np, ys_np
+        n_best = max_points // 2
+        order = np.argsort(ys_np)
+        rest = order[n_best:]
+        rng = np.random.RandomState(int(np.asarray(ks)[-1]) & 0x7fffffff)
+        pick = rng.choice(len(rest), max_points - n_best, replace=False)
+        idx = np.concatenate([order[:n_best], rest[pick]])
+        return xs_np[idx], ys_np[idx]
+
+    def _refit_full(self, xs_np, ys_np, ks, kf,
+                    background: bool = False) -> None:
+        """The full fit: host-side subsample + zero-pad to the bucket
+        (numpy — no device dispatch, no per-n tracing), then ONE jitted
+        program per bucket (fit_auto hyperparameter sweep when
+        hyper_fit), then publish one immutable snapshot."""
+        t0 = time.perf_counter()
+        n_total = len(ys_np)
+        xs_sub, ys_sub = self._host_subsample(xs_np, ys_np,
+                                              ks, self.max_points)
+        n = len(ys_sub)
+        bucket = self.fit_bucket(n_total)
+        pad = bucket - n
+        xp = np.concatenate(
+            [xs_sub, np.zeros((pad, xs_sub.shape[1]), np.float32)])
+        yp = np.concatenate([ys_sub, np.zeros(pad, np.float32)])
+        mp = np.concatenate(
+            [np.ones(n, np.float32), np.zeros(pad, np.float32)])
+        dev = self._refit_device
+        if dev is not None:
+            x = jax.device_put(xp, dev)
+            y = jax.device_put(yp, dev)
+            mask = jax.device_put(mp, dev)
+            kf = jax.device_put(kf, dev)
+        else:
+            x, y, mask = jnp.asarray(xp), jnp.asarray(yp), jnp.asarray(mp)
+        fit = self._fit_jit[bucket]
+        if self.kind == "gp":
+            state = fit(x, y, mask)
+            if self._use_kinv:
+                # large pools score through the fused Pallas variance
+                # path; attach the premasked K^-1 ONCE per publish
+                # rather than once per pool pull (r5 review)
+                state = gp_mod.precompute_kinv(state)
+        else:
+            state = fit(kf, x, y, mask)
+        if dev is not None:
+            # bring the fitted state home to the driver's device so
+            # scoring/extension never execute on (or transfer from) the
+            # refit device; O(bucket^2) bytes, trivial next to the fit
+            state = jax.device_put(state, jax.local_devices()[0])
+        # a published snapshot must be DONE computing: the first reader
+        # on the driver thread must never pay this fit's device work
+        state = jax.block_until_ready(state)
+        finite = ys_np[np.isfinite(ys_np)]
+        thr = (float(np.quantile(finite, self.keep_quantile))
+               if len(finite) else None)
+        besty = float(finite.min()) if len(finite) else None
         if self.flip_bias == "online" and self._n_cat:
             # per-group |Pearson r| over this run's own rows -> flip
             # weights on the backing scalar lanes (see __init__)
             from .screen import lane_sensitivity
-            scores = lane_sensitivity(xs_np,
-                                      np.asarray(self._ys, np.float64))
+            scores = lane_sensitivity(xs_np, ys_np.astype(np.float64))
             width = self.space.cat_max_codes
             gs = scores[self._n_cont:].reshape(
                 self._n_cat, width).max(axis=1)
@@ -319,16 +563,140 @@ class SurrogateManager:
             lanes = np.asarray(self.space.cat_lane_idx)[self._cat_groups]
             w[lanes] = gs / gs.max() if gs.max() > 0 else 1.0
             self._online_cat_w = w
+        with self._pub_lock:
+            self._version += 1
+            self._snap = SurrogateSnapshot(
+                state, self._version, n_total, thr, besty,
+                exact=n_total <= self.max_points, in_bucket=n)
+            self.refits += 1
+        ext = self._ext_jit.get(bucket)
+        if ext is not None and n < bucket and n_total <= self.max_points:
+            # warm the extension wrapper for THIS bucket on the refit
+            # thread (throwaway call, result discarded): its first-use
+            # trace+compile otherwise lands on whichever driver tell
+            # next folds a row in — the exact latency spike the async
+            # plane exists to remove
+            jax.block_until_ready(ext(
+                state, jnp.zeros(state.x.shape[1], jnp.float32),
+                jnp.float32(besty if besty is not None else 0.0),
+                jnp.int32(n)))
+        dt = time.perf_counter() - t0
+        if background:
+            self.t_refit_bg_total += dt
+        else:
+            self.t_refit_last = dt
+            self.t_refit_total += dt
+
+    def _poll_refit(self) -> bool:
+        """Consume a FINISHED background fit without blocking: True when
+        one published since the last poll.  A failed fit warns and
+        re-arms the cadence so the next tick retries."""
+        f = self._refit_future
+        if f is None or not f.done():
+            return False
+        self._refit_future = None
+        exc = f.exception()
+        if exc is None:
+            return True
+        import warnings
+        warnings.warn(
+            f"background surrogate refit failed: {exc!r}; the last "
+            f"published snapshot stays live, retrying at the next "
+            f"cadence", RuntimeWarning)
+        self._since_fit = max(self._since_fit, self.refit_interval)
+        return False
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until any in-flight background refit has published (or
+        failed); True when nothing is left in flight.  The sync
+        barrier: tests pin publication points with it, Tuner.close()
+        uses it so no worker outlives the run, and bench protocols call
+        it between matched-seed phases."""
+        f = self._refit_future
+        if f is None:
+            return True
+        from concurrent.futures import TimeoutError as _FTimeout
+        try:
+            f.exception(timeout)   # waits; does not raise the fit's exc
+        except _FTimeout:
+            return False
+        self._poll_refit()
         return True
+
+    def close(self) -> None:
+        """Let an in-flight background refit publish, then shut the
+        worker thread down.  Without this each async manager leaves one
+        idle non-daemon 'ut-surrogate-refit' thread for the process
+        lifetime; maybe_refit() lazily recreates the executor if the
+        manager is used again."""
+        self.drain()
+        if self._refit_exec is not None:
+            self._refit_exec.shutdown(wait=True)
+            self._refit_exec = None
+
+    def _maybe_extend(self) -> int:
+        """Fold observations past the published watermark into the
+        snapshot via rank-1 Cholesky extension: O(N^2) per row inside
+        the padded bucket (static shapes — the per-bucket wrapper from
+        __init__ traces once), at the hyperparameters and target
+        standardization of the last full fit.  Skipped when the last
+        fit subsampled (row slots no longer align) or the bucket is
+        full; the cadence-driven full refit covers those regimes.
+        Returns the rows folded in."""
+        snap = self._snap
+        if (not self.incremental or self.kind != "gp" or snap is None
+                or not snap.exact):
+            return 0
+        n = self.n_points
+        bucket = int(snap.state.x.shape[0])
+        fn = self._ext_jit.get(bucket)
+        if n <= snap.n_rows or snap.in_bucket >= bucket or fn is None:
+            return 0
+        ys = self._ys
+        worst = max((v for v in ys if np.isfinite(v)), default=None)
+        if worst is None:
+            return 0
+        st, rows, i = snap.state, 0, snap.n_rows
+        while i < n and snap.in_bucket + rows < bucket \
+                and rows < self._ext_per_tick:
+            q = ys[i] if np.isfinite(ys[i]) else worst
+            st = fn(st, jnp.asarray(self._xs[i], jnp.float32),
+                    jnp.float32(q), jnp.int32(snap.in_bucket + rows))
+            rows += 1
+            i += 1
+        fin = np.asarray([v for v in ys[:i] if np.isfinite(v)],
+                         np.float32)
+        thr = (float(np.quantile(fin, self.keep_quantile))
+               if len(fin) else None)
+        besty = float(fin.min()) if len(fin) else None
+        with self._pub_lock:
+            if self._snap is not snap:
+                # a background full fit published mid-extension: it is
+                # the newer model (fresh hyperparameters) — discard the
+                # extension; the next tick re-extends from ITS watermark
+                return 0
+            self._version += 1
+            self._snap = snap._replace(
+                state=st, version=self._version, n_rows=i,
+                threshold=thr, best_y=besty,
+                in_bucket=snap.in_bucket + rows)
+        self.incr_updates += rows
+        return rows
 
     def force_refit(self) -> bool:
         """Fit NOW if the point count allows, ignoring the
         `refit_interval` cadence — the warm-start hook: after a bulk
         ingestion of stored trials the model should guide from the very
         first live acquisition instead of waiting out the online
-        cadence."""
+        cadence.  Forced-SYNC even under async_refit (after draining
+        any in-flight background fit): PR 4 preload semantics and
+        exact replay depend on the model being ready on return."""
+        self.drain()
         self._since_fit = max(self._since_fit, self.refit_interval)
-        return self.maybe_refit()
+        if self.n_points < self.min_points:
+            return False
+        self._refit_full(*self._refit_args())
+        return True
 
     def warm_start(self, feats: np.ndarray, qor: np.ndarray) -> bool:
         """Bulk-ingest externally-recorded (features, engine-oriented
@@ -368,26 +736,32 @@ class SurrogateManager:
         (novel, non-pending); topk ranks ONLY among those — otherwise
         already-evaluated duplicate rows could fill every top-k slot and
         starve the novel candidates."""
-        if not self.fitted or self._threshold is None:
+        # ONE read of the published snapshot: state/threshold/incumbent
+        # travel together, so a concurrent background publish can never
+        # mix model versions inside a single scoring call
+        snap = self._snap
+        if snap is None or snap.threshold is None:
             return None
         if self.passive or self.n_points < self.min_model_points:
             return None     # guards: see __init__
         feats = self._sx(self.space.features(cands))
         preds = None
         use_ei = (self.select == "topk" and self.score_kind == "ei"
-                  and self._best_y is not None)
+                  and snap.best_y is not None)
         if self.kind == "gp":
+            bucket = int(snap.state.x.shape[0])
             if use_ei:
-                score = -np.asarray(self._score_ei(
-                    self._state, feats, jnp.float32(self._best_y)))
+                score = -np.asarray(self._score_ei_jit[bucket](
+                    snap.state, feats, jnp.float32(snap.best_y)))
             else:
-                score = np.asarray(self._score(self._state, feats))
+                score = np.asarray(self._score_jit[bucket](
+                    snap.state, feats))
         else:
-            preds = np.asarray(self._score(self._state, feats))  # [E, B]
+            preds = np.asarray(self._score(snap.state, feats))  # [E, B]
             score = preds.mean(axis=0)
             if use_ei:
                 score = -np.asarray(gp_mod.ei_from_moments(
-                    score, preds.std(axis=0), self._best_y))
+                    score, preds.std(axis=0), snap.best_y))
         if self.select == "topk":
             b = score.shape[0]
             if candidate_mask is not None:
@@ -400,9 +774,9 @@ class SurrogateManager:
             if n_elig:
                 keep[np.argsort(score)[:min(k, n_elig)]] = True
         elif self.kind == "gp":
-            keep = score <= self._threshold
+            keep = score <= snap.threshold
         else:
-            votes = (preds <= self._threshold).mean(axis=0)
+            votes = (preds <= snap.threshold).mean(axis=0)
             keep = votes >= self.majority
         b = keep.shape[0]
         self._key, ke = jax.random.split(self._key)
@@ -551,18 +925,32 @@ class SurrogateManager:
             idx = jnp.argsort(score)[:n_out]
             return cands[idx]
 
-        return jax.jit(pool_fn)
+        return pool_fn
 
     def propose_pool(self, key, best_u, best_perms, best_y):
         """EI-maximizing CandBatch of `propose_batch` candidates, or None
         when disabled / not yet fitted / passive."""
-        if self.propose_batch <= 0 or not self.fitted:
+        snap = self._snap   # one atomic snapshot read (see keep_mask)
+        if self.propose_batch <= 0 or snap is None:
             return None
         if self.passive or self.n_points < self.min_model_points:
             return None     # guards: see __init__
 
         if self._pool_jit is None:
-            self._pool_jit = self._build_pool_fn()
-        return self._pool_jit(self._state, key, best_u, best_perms,
-                              jnp.asarray(best_y, jnp.float32),
-                              self._flip_probs())
+            # the whole per-bucket fleet is built at once, BEFORE any
+            # wrapper traces (same trace-accounting rationale as the
+            # __init__ fleets); the mlp state is bucket-independent so
+            # one wrapper serves every bucket there
+            fn = self._build_pool_fn()
+            if self.kind == "gp":
+                self._pool_jit = {bb: jax.jit(fn)
+                                  for bb in self._buckets}
+            else:
+                one = jax.jit(fn)
+                self._pool_jit = {bb: one for bb in self._buckets}
+        bucket = (int(snap.state.x.shape[0]) if self.kind == "gp"
+                  else self._buckets[0])
+        return self._pool_jit[bucket](snap.state, key, best_u,
+                                      best_perms,
+                                      jnp.asarray(best_y, jnp.float32),
+                                      self._flip_probs())
