@@ -460,8 +460,11 @@ def surrogate_main() -> None:
     # full mode runs 1000 lockstep tells: background fits at bucket 512
     # take ~1 s, so the async side opens a refit window only every
     # ~100+ tells — a shorter run leaves its p95 resting on a handful
-    # of windows
-    trials = 150 if quick else 1000
+    # of windows.  quick is the tier-1 smoke: 100 tells still yields
+    # ~6 refit windows (>=3 warm) at the capped-64 bucket while
+    # keeping the 3-run protocol inside the suite's time budget
+    # (ISSUE 6 — tier-1 runs within ~60s of the 870s timeout)
+    trials = 100 if quick else 1000
     # the latency protocol probes the LEARNING-COST regime the async
     # plane exists for: max_points 512 (between the calibrated 256 and
     # the manager default 1024), where the O(N^3) fit + 43-point
@@ -654,6 +657,266 @@ def surrogate_main() -> None:
     print(json.dumps(result))
 
 
+def multi_main() -> None:
+    """`bench.py --multi`: the batched multi-instance engine benchmark
+    (docs/PERF.md "Batched multi-instance engine") — aggregate
+    candidate acquisitions/sec of N independent on-device tunes run as
+    ONE vmapped donate-in-place program (engine/batched.py), next to
+    an honest N-sequential-runs baseline measured with the same
+    compiled single-instance program.
+
+    Protocol: rosenbrock-16d, per-instance default arms (scale=1) and
+    a 2^11 dedup history; N=256 instances (32 at --quick).  TWO
+    sequential baselines, both recorded:
+
+    * `speedup_vs_warm_sequential` — N x the wall of the SAME
+      compiled single-instance jit_run(steps) (warm, donated, in
+      process).  This is the strictest possible baseline: the single
+      engine is already one fused lax.scan program, so on a
+      throughput-bound CPU this ratio mostly reflects batching's
+      per-op overhead amortization (small); on TPU it reflects how
+      empty the chip was (BENCH_TPU.json: MXU util 6e-06).
+    * `speedup_vs_sequential_processes` (full runs only) — N x the
+      measured wall of ONE fresh single-instance tune process
+      (interpreter + jax import + trace/compile + run), the
+      reference's actual multi-instance deployment shape (one
+      OpenTuner process per instance, PAPER.md L4/L5) and what 'run N
+      tunes today' costs without this engine.
+
+    On TPU with multiple chips the instance axis shard_maps across
+    them and the headline stays PER-CHIP (aggregate / n_devices).
+    Run under UT_TRACE_GUARD=strict to prove the whole batched run
+    compiles once.  Writes BENCH_MULTI.json (.quick.json for --quick)
+    with XLA cost-model roofline fields in the BENCH_TPU.json
+    style."""
+    quick = "--quick" in sys.argv
+    jax, platform = _init_backend(
+        cpu_flag="--cpu" in sys.argv,
+        wait_for_tpu="--wait-for-tpu" in sys.argv)
+    if platform == "cpu:fallback":
+        quick = True
+
+    from uptune_tpu.analysis.trace_guard import guard_from_env
+    with guard_from_env() as guard:
+        from uptune_tpu.engine import (BatchedEngine, FusedEngine,
+                                       default_arms, make_instance_mesh)
+        from uptune_tpu.workloads import rosenbrock_device, rosenbrock_space
+
+        n_inst = 32 if quick else 256
+        steps = 10 if quick else 50
+        space = rosenbrock_space(16, -5.0, 5.0)
+
+        def build_engine():
+            # per-instance arms at scale=1: the chip fills along the
+            # INSTANCE axis, not by inflating one tune's populations
+            return FusedEngine(space, lambda v, p: rosenbrock_device(v),
+                               arms=default_arms(scale=1),
+                               history_capacity=1 << 11)
+
+        eng = build_engine()
+        n_dev = len(jax.devices())
+        mesh = None
+        if platform not in ("cpu", "cpu:fallback") and n_dev > 1:
+            while n_inst % n_dev:
+                n_dev -= 1
+            mesh = make_instance_mesh(n_dev)
+        else:
+            n_dev = 1
+        be = BatchedEngine(eng, n_inst, mesh=mesh)
+
+        # constant seeds by design: a measured bench must replay the
+        # same stream run-to-run
+        state = be.init(jax.random.PRNGKey(0))  # ut-lint: disable=R002
+        lowered = be.jit_run(steps).lower(state)
+        compiled = lowered.compile()
+        state = compiled(state)         # warm (donated; rebind)
+        jax.block_until_ready(state)
+        total_flops, total_bytes = _cost_analysis(compiled)
+
+        reps = 3
+        rep_times = []
+        for r in range(reps):
+            # identical reps measure wall time, not search quality
+            # ut-lint: disable-next=R002
+            s = be.init(jax.random.PRNGKey(1))
+            jax.block_until_ready(s)
+            t0 = time.perf_counter()
+            s = compiled(s)
+            jax.block_until_ready(s)
+            rep_times.append(time.perf_counter() - t0)
+        best_t = min(rep_times)
+
+        # N-sequential baseline: one instance, same shapes, same
+        # compiled program reused (warm) — what a loop over N seeds
+        # of the single-instance engine would cost, minus its N-1
+        # extra dispatch/compile overheads (lower-bound speedup)
+        seq_run = eng.jit_run(steps)
+        st1 = eng.init(jax.random.PRNGKey(2))  # ut-lint: disable=R002
+        st1 = seq_run(st1)              # warm + compile
+        jax.block_until_ready(st1)
+        seq_times = []
+        for r in range(reps):
+            s1 = eng.init(jax.random.PRNGKey(3))  # ut-lint: disable=R002
+            jax.block_until_ready(s1)
+            t0 = time.perf_counter()
+            s1 = seq_run(s1)
+            jax.block_until_ready(s1)
+            seq_times.append(time.perf_counter() - t0)
+        t_single = min(seq_times)
+
+        # one-process baseline (full CPU runs only): a fresh
+        # interpreter running the same single-instance tune end to end
+        # — the reference's one-process-per-instance shape.  Measured,
+        # not estimated; multiplied by N for the process-sequential
+        # speedup.  Skipped on accelerators: a second process cannot
+        # share the chip the parent holds, and a CPU child divided by
+        # a TPU batched wall would be a cross-backend ratio dressed up
+        # as like-for-like — the TPU story is utilization + the warm
+        # baseline.
+        t_process = None
+        if not quick and platform in ("cpu", "cpu:fallback"):
+            import subprocess
+            code = (
+                "from uptune_tpu.utils.platform_guard import force_cpu\n"
+                "force_cpu(1)\n"
+                "import jax\n"
+                "from uptune_tpu.engine import FusedEngine, default_arms\n"
+                "from uptune_tpu.workloads import rosenbrock_device, \\\n"
+                "    rosenbrock_space\n"
+                "space = rosenbrock_space(16, -5.0, 5.0)\n"
+                "eng = FusedEngine(space,\n"
+                "                  lambda v, p: rosenbrock_device(v),\n"
+                "                  arms=default_arms(scale=1),\n"
+                "                  history_capacity=1 << 11)\n"
+                f"s = eng.init(jax.random.PRNGKey(0))\n"
+                f"s = eng.jit_run({steps})(s)\n"
+                "jax.block_until_ready(s)\n")
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, "-c", code], capture_output=True,
+                text=True, timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            if proc.returncode == 0:
+                t_process = time.perf_counter() - t0
+            else:  # record the failure, never a fabricated number
+                print(f"bench: process-baseline run failed "
+                      f"(rc={proc.returncode}): "
+                      f"{proc.stderr.strip()[-300:]}", file=sys.stderr)
+
+        # portfolio mode (full runs only): the same batch with the
+        # on-device best-exchange collective every 16 steps — records
+        # what cooperation costs next to independent instances
+        exch_rate = None
+        if not quick:
+            bex = BatchedEngine(eng, n_inst, exchange_every=16,
+                                mesh=mesh)
+            sx = bex.jit_run(steps)(bex.init(jax.random.PRNGKey(4)))  # ut-lint: disable=R002
+            jax.block_until_ready(sx)
+            sx = None
+            # init lands BEFORE t0, matching the headline/sequential
+            # measurement windows (timed: the compiled run only)
+            s5 = bex.init(jax.random.PRNGKey(5))  # ut-lint: disable=R002
+            jax.block_until_ready(s5)
+            t0 = time.perf_counter()
+            s5 = bex.jit_run(steps)(s5)
+            jax.block_until_ready(s5)
+            exch_rate = steps * n_inst * eng.total_batch / (
+                time.perf_counter() - t0)
+
+    acqs = steps * n_inst * eng.total_batch
+    rate = acqs / best_t
+    rate_chip = rate / n_dev
+    speedup = n_inst * t_single / best_t
+    result = {
+        "metric": "multi_instance_agg_acqs_per_sec_per_chip",
+        "value": round(rate_chip, 1),
+        "unit": "configs/s (aggregate over instances / devices)",
+        "platform": platform,
+        "quick": quick,
+        "n_instances": n_inst,
+        "n_devices": n_dev,
+        "steps": steps,
+        "per_instance_batch": eng.total_batch,
+        "acquisitions": acqs,
+        "agg_rate_all_devices": round(rate, 1),
+        "rep_wall_s": [round(t, 4) for t in rep_times],
+        # strictest baseline: N sequential runs of the SAME compiled
+        # single-instance program, warm + donated, in process — no
+        # startup, no compile, no dispatch gaps.  On CPU both sides
+        # are throughput-bound, so this ratio is small by design; the
+        # chip-filling win is the TPU story (utilization fields below)
+        "seq_single_wall_s": [round(t, 4) for t in seq_times],
+        "speedup_vs_warm_sequential": round(speedup, 2),
+        "nproc": os.cpu_count(),
+    }
+    if t_process is not None:
+        # the reference's deployment shape: one process per instance
+        # (CPU-only protocol — both sides on the same backend)
+        result["seq_process_wall_s"] = round(t_process, 2)
+        result["seq_process_platform"] = "cpu"
+        result["speedup_vs_sequential_processes"] = round(
+            n_inst * t_process / best_t, 1)
+    if exch_rate is not None:
+        result["exchange_every_16_agg_rate"] = round(exch_rate, 1)
+    if guard.enabled:
+        result["retraces"] = guard.report()
+
+    dev = jax.devices()[0]
+    device_kind = getattr(dev, "device_kind", "?")
+    flops_per_s = total_flops / best_t if total_flops else None
+    bytes_per_s = total_bytes / best_t if total_bytes else None
+    util = _utilization(device_kind, flops_per_s, bytes_per_s)
+    result["cost_analysis"] = {
+        "total_flops": total_flops,
+        "total_bytes_accessed": total_bytes,
+        "flops_per_s": flops_per_s,
+        "bytes_per_s": bytes_per_s,
+        **util,
+        "note": ("XLA cost model over the whole compiled batched "
+                 "run(steps) program; peaks are published per-chip "
+                 "specs (bf16 MXU / HBM), so utilization values are "
+                 "estimates" + (
+                     "" if platform not in ("cpu", "cpu:fallback") else
+                     "; no published roofline peaks for the CPU "
+                     "fallback — utilization fields apply on TPU only")),
+    }
+    artifact = {
+        **result,
+        "devices": repr(jax.devices()),
+        "device_kind": device_kind,
+        "jax_version": jax.__version__,
+        "captured_unix": time.time(),
+        "protocol": {
+            "space": "rosenbrock-16d",
+            "arms": "default_arms(scale=1) per instance",
+            "history_capacity": 1 << 11,
+            "exchange": "independent instances (headline); "
+                        "exchange_every=16 portfolio recorded "
+                        "separately on full runs",
+            "warm_sequential_baseline":
+                "same compiled single-instance jit_run(steps), warm + "
+                "donated, in process, best of 3; speedup = N * "
+                "t_single / t_batched (the strictest baseline: no "
+                "startup, no compile, no dispatch)",
+            "process_sequential_baseline":
+                "one MEASURED fresh single-instance tune process "
+                "(interpreter + jax import + compile + run) x N — the "
+                "reference's one-OpenTuner-process-per-instance shape "
+                "(PAPER.md L4/L5); full CPU runs only (skipped on "
+                "accelerators: a second process cannot share the "
+                "parent's chip, and a cross-backend ratio would not "
+                "be like-for-like)",
+        },
+    }
+    name = "BENCH_MULTI.quick.json" if quick else "BENCH_MULTI.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"bench: multi-instance evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps(result))
+
+
 def main() -> None:
     if "--driver" in sys.argv:
         driver_main()
@@ -663,6 +926,9 @@ def main() -> None:
         return
     if "--surrogate" in sys.argv:
         surrogate_main()
+        return
+    if "--multi" in sys.argv:
+        multi_main()
         return
     quick = "--quick" in sys.argv
     jax, platform = _init_backend(

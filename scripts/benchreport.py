@@ -280,6 +280,26 @@ PROBLEM_BUDGETS = {
 # protocols into one table (r4: gcc-real gained the -O2 seed trial and
 # moved the threshold 0.85→0.78×t_O2).  Synthetic problems are at their
 # original protocol (None == legacy rows remain valid).
+# Whether the driver's run-budget rule engages at the problem's full
+# budget — the ACTUAL predicate (_apply_budget_rule: test_limit <
+# space.n_scalar), not a problem-name prefix (ADVICE r5: keying the
+# budget_rule=v2 'surrogate' fingerprint on the 'gcc-real' name would
+# silently merge pre- and post-v2 rows for any future problem entering
+# the small-budget regime).  Static for the same reason as
+# PROBLEM_BUDGETS (merge-only passes must not instantiate factories
+# with build side effects); run_suite asserts it against the real
+# space, so drift — e.g. a g++ whose mined flag count drops below the
+# budget — is caught on every real run.  The budget itself is
+# fingerprinted separately, so scaled (--quick) budgets never alias.
+PROBLEM_SMALL_BUDGET = {
+    "rosenbrock-2d": False,     # 2000 evals >> 2 scalar params
+    "rosenbrock-4d": False,
+    "gcc-options": False,       # 6000 evals >> mined flag count
+    "gcc-real": True,           # 80 evals < ~330 mined g++ flags
+    "gcc-real-mmm": True,
+    "gcc-real-stencil": True,
+}
+
 PROBLEM_PROTO = {
     "gcc-real": "v2:seeded+0.78xO2",
     "gcc-real-mmm": "v2:seeded+0.78xO2",
@@ -390,11 +410,12 @@ def _sopts_sig(mode: str, problem: str = ""):
     if mode == "surrogate":
         # budget_rule=v2: the driver's small-budget rule now applies
         # the bandit-arbitrated recipe instead of passivating (r5).
-        # Only the gcc-real problems run in that regime (budget 80 <
-        # ~330 params), so only THEIR pre-v2 "surrogate" rows changed
-        # meaning; the synthetic sweeps (budget >> params, rule never
-        # engages) keep their cached 30-seed rows
-        if problem.startswith("gcc-real"):
+        # Only problems in that regime (budget < n_scalar — the
+        # driver's own predicate, mirrored statically in
+        # PROBLEM_SMALL_BUDGET) had their pre-v2 "surrogate" rows
+        # change meaning; the synthetic sweeps (budget >> params, rule
+        # never engages) keep their cached 30-seed rows
+        if PROBLEM_SMALL_BUDGET.get(problem, False):
             return json.dumps(dict(SURROGATE_SOPTS, budget_rule="v2"),
                               sort_keys=True)
         return json.dumps(SURROGATE_SOPTS, sort_keys=True)
@@ -432,10 +453,29 @@ def run_suite(problems, seeds: int, budget_scale: float = 1.0,
     state_f = open(state_path, "a") if state_path else None
     rows = []
     for prob in problems:
-        full_budget = PROBLEMS[prob]()[3]
+        prob_space, _, _, full_budget = PROBLEMS[prob]()
         assert full_budget == PROBLEM_BUDGETS[prob], (
             f"{prob}: factory budget {full_budget} != static table "
             f"{PROBLEM_BUDGETS[prob]} — update PROBLEM_BUDGETS")
+        small = full_budget < prob_space.n_scalar
+        assert small == PROBLEM_SMALL_BUDGET.get(prob, False), (
+            f"{prob}: budget {full_budget} vs n_scalar "
+            f"{prob_space.n_scalar} => small-budget rule {small}, but "
+            f"PROBLEM_SMALL_BUDGET says otherwise — update the table "
+            f"(its value keys the budget_rule=v2 cache fingerprint)")
+        # the driver evaluates its predicate on the SCALED run budget;
+        # a scale that flips the regime relative to the static table
+        # would fingerprint v2 rows as non-v2 (or vice versa) and
+        # alias them — refuse loudly instead of writing aliased rows
+        scaled_small = int(full_budget * budget_scale) < \
+            prob_space.n_scalar
+        assert scaled_small == small, (
+            f"{prob}: budget_scale={budget_scale} moves the run "
+            f"across the small-budget boundary (scaled "
+            f"{int(full_budget * budget_scale)} vs n_scalar "
+            f"{prob_space.n_scalar}) — rows at this scale would alias "
+            f"the budget_rule=v2 fingerprint; pick a scale on the "
+            f"same side as the full budget")
         budget = int(full_budget * budget_scale)
         for mode in (_norm_mode(m) for m in modes):
             per_seed = []

@@ -1,0 +1,425 @@
+"""Batched multi-instance engine (ISSUE 6): matched-seed parity with
+sequential single-instance runs, the Pallas dedup merge vs the XLA
+fallback, fused flat surrogate scoring, the on-device best-exchange
+collective, shard_map scale-out over the instance axis, the tune_batch
+library surface, strict trace-guard cleanliness, and the bench.py
+--multi smoke.
+
+Tier-1 budget discipline: compiles dominate these tests' cost, so the
+rosenbrock runs share ONE module-scoped engine + compiled programs
+(fixtures below), sizes stay tiny (2-d space, <=8 steps, 1<<9
+histories), and every result consumed by several tests is computed
+once."""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from uptune_tpu.driver.history import History
+from uptune_tpu.engine import (BatchedEngine, FusedEngine,
+                               make_instance_mesh, surrogate_eval_fn)
+from uptune_tpu.ops import dedup
+from uptune_tpu.workloads import (random_tsp_distances, rosenbrock_device,
+                                  rosenbrock_space, tsp_device, tsp_space)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+KEY = jax.random.PRNGKey(7)
+STEPS = 8
+
+HIST_FIELDS = ("h0", "h1", "qor", "n", "age", "step", "dropped")
+
+
+def _rb_obj(vals, perms):
+    return rosenbrock_device(vals)
+
+
+def _eq(a, b):
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture(scope="module")
+def rb_eng():
+    """One shared 2-d engine: 8 steps x 114 cands > 1<<9 capacity,
+    so the matched-seed parity runs exercise EVICTION through both the
+    per-instance cond predicate (sequential) and the batched engine's
+    conservative batch-level gate."""
+    return FusedEngine(rosenbrock_space(2, -3.0, 3.0), _rb_obj,
+                       history_capacity=1 << 9)
+
+
+@pytest.fixture(scope="module")
+def seq_run(rb_eng):
+    """The sequential single-instance program, compiled once."""
+    return jax.jit(lambda s: rb_eng.run(s, STEPS))
+
+
+@pytest.fixture(scope="module")
+def batched4(rb_eng):
+    """(engine, final state) of the shared N=4 batched run."""
+    be = BatchedEngine(rb_eng, 4)
+    return be, be.run(be.init(KEY), STEPS)
+
+
+@pytest.fixture(scope="module")
+def exchange4(rb_eng):
+    """(engine, final state) of the N=4 portfolio run (exchange at
+    steps 3 and 6)."""
+    be = BatchedEngine(rb_eng, 4, exchange_every=3)
+    return be, be.run(be.init(KEY), STEPS)
+
+
+class TestBatchedParity:
+    def test_n1_exact_parity(self, rb_eng, seq_run):
+        """A 1-instance batched run IS the single engine: best config,
+        best qor, history table and counters match BITWISE — including
+        the eviction steps (capacity overflows at step 5)."""
+        be = BatchedEngine(rb_eng, 1)
+        sb = be.run(be.init(KEY), STEPS)
+        ss = seq_run(rb_eng.init(be.instance_keys(KEY)[0]))
+        _eq(sb.best.qor[0], ss.best.qor)
+        _eq(sb.best.u[0], ss.best.u)
+        _eq(sb.hist.h0[0], ss.hist.h0)
+        _eq(sb.hist.qor[0], ss.hist.qor)
+        _eq(sb.hist.dropped[0], ss.hist.dropped)
+        _eq(sb.evals[0], ss.evals)
+        _eq(sb.acqs[0], ss.acqs)
+
+    def test_matched_seed_equivalence_n4(self, batched4, rb_eng,
+                                         seq_run):
+        """Without exchange, every instance's result equals the
+        sequential single-instance run started from the same derived
+        key — N independent tunes, one program (ISSUE 6 acceptance)."""
+        be, s4 = batched4
+        for i, k in enumerate(be.instance_keys(KEY)):
+            si = seq_run(rb_eng.init(k))
+            _eq(s4.best.qor[i], si.best.qor)
+            _eq(s4.best.u[i], si.best.u)
+            _eq(s4.evals[i], si.evals)
+            _eq(s4.hist.dropped[i], si.hist.dropped)
+
+    def test_perm_space_batched(self):
+        n = 8
+        dist = jnp.asarray(random_tsp_distances(n, seed=5))
+        eng = FusedEngine(tsp_space(n),
+                          lambda v, perms: tsp_device(perms[0], dist),
+                          history_capacity=1 << 10)
+        be = BatchedEngine(eng, 2)
+        st = be.run(be.init(jax.random.PRNGKey(0)), 6)
+        for cfg in be.best_configs(st):
+            assert sorted(cfg["tour"]) == list(range(n))
+        assert np.isfinite(be.best_qors(st)).all()
+
+    def test_run_traced_per_instance_monotone(self, rb_eng):
+        be = BatchedEngine(rb_eng, 2)
+        _, traces = jax.jit(lambda s: be.run_traced(s, 4))(
+            be.init(jax.random.PRNGKey(1)))
+        tr = np.asarray(traces)
+        assert tr.shape == (4, 2)
+        assert (np.diff(tr, axis=0) <= 1e-9).all()
+
+    def test_best_reporting(self, batched4):
+        be, st = batched4
+        qors = be.best_qors(st)
+        cfg, q = be.best(st)
+        i = int(np.argmin(qors))
+        assert q == qors[i]
+        assert cfg == be.best_config(st, i)
+        assert len(be.best_configs(st)) == 4
+
+
+class TestExchange:
+    def test_exchange_propagates_best(self, exchange4, batched4):
+        """Portfolio mode: after an exchange step every instance's
+        incumbent equals the global best (the reference's epoch sync,
+        on device) — and the cooperative global best is at least as
+        good as the independent instances' (same seeds)."""
+        _, sx = exchange4
+        q = np.asarray(sx.best.qor)
+        assert np.isfinite(q).all()
+        np.testing.assert_allclose(q, q.min(), atol=0)
+        _, si = batched4
+        assert q.min() <= float(np.asarray(si.best.qor).min()) + 1e-6
+
+
+class TestPallasDedupMerge:
+    @staticmethod
+    def _mk(rng, cap, b, n_live, sent_batch=8):
+        h0 = np.sort(rng.randint(0, 2**31, n_live).astype(np.uint32))
+        h0 = np.concatenate(
+            [h0, np.full(cap - n_live, 0xFFFFFFFF, np.uint32)])
+        h1 = rng.randint(0, 2**32, cap).astype(np.uint32)
+        q = rng.randn(cap).astype(np.float32)
+        q[n_live:] = np.inf
+        age = np.concatenate(
+            [rng.randint(0, 50, n_live),
+             np.full(cap - n_live, -1)]).astype(np.int32)
+        h0s = rng.randint(0, 2**31, b).astype(np.uint32)
+        if n_live and b > 4:    # force history collisions
+            h0s[:3] = h0[:3]
+        if sent_batch and b > sent_batch:  # invalid (sentinel) rows
+            h0s[-sent_batch:] = 0xFFFFFFFF
+        h0s = np.sort(h0s)
+        hist = tuple(jnp.asarray(a) for a in (h0, h1, q, age))
+        new = tuple(jnp.asarray(a) for a in (
+            h0s, rng.randint(0, 2**32, b).astype(np.uint32),
+            rng.randn(b).astype(np.float32), np.full(b, 50, np.int32)))
+        pos = (jnp.arange(b, dtype=jnp.int32)
+               + jnp.searchsorted(hist[0], new[0],
+                                  side="right").astype(jnp.int32))
+        return hist, new, pos
+
+    @pytest.mark.parametrize("cap,b,n_live", [
+        (2048, 300, 1500),   # mid-fill, collisions, sentinel rows
+        (2048, 2048, 2000),  # full-tile batch, near-full history
+    ])
+    def test_merge_parity(self, cap, b, n_live):
+        """The Pallas kernel (interpret mode — the CPU parity harness,
+        same as pallas_score) is BITWISE equal to the XLA
+        gather+cumsum fallback."""
+        rng = np.random.RandomState(cap + b)
+        hist, new, pos = self._mk(rng, cap, b, n_live)
+        outx = dedup.merge_rows_xla(hist, new, pos)
+        outp = dedup.merge_rows_pallas(hist, new, pos, interpret=True)
+        for name, a, p in zip(("h0", "h1", "qor", "age"), outx, outp):
+            assert np.array_equal(np.asarray(a), np.asarray(p),
+                                  equal_nan=True), name
+
+    def test_history_insert_parity_with_eviction(self):
+        """History.insert(merge_impl='pallas') == 'xla' across rounds
+        that overflow capacity — the merge AND the (rewritten,
+        sort-free) eviction agree."""
+        cap = 2048
+        hx, hp = History(cap, "xla"), History(cap, "pallas")
+        stx, stp = hx.init(), hp.init()
+        rng = np.random.RandomState(17)
+        ins_x, ins_p = jax.jit(hx.insert), jax.jit(hp.insert)
+        for _ in range(5):   # 5 * ~480 valid rows > cap => eviction
+            hashes = jnp.asarray(
+                rng.randint(0, 2**31, (600, 2)).astype(np.uint32))
+            qor = jnp.asarray(rng.randn(600).astype(np.float32))
+            valid = jnp.asarray(rng.rand(600) > 0.2)
+            stx = ins_x(stx, hashes, qor, valid)
+            stp = ins_p(stp, hashes, qor, valid)
+        assert int(stx.dropped) > 0   # eviction actually exercised
+        for name, a, p in zip(HIST_FIELDS, stx, stp):
+            assert np.array_equal(np.asarray(a), np.asarray(p),
+                                  equal_nan=True), name
+
+    @pytest.mark.slow
+    def test_batched_engine_pallas_merge_parity(self):
+        """A whole batched engine run with merge_impl='pallas'
+        (vmapped pallas_call, interpret mode) equals 'xla'.
+        Slow-marked (suite budget): the kernel itself is bitwise
+        parity-tested tier-1 by test_merge_parity and
+        test_history_insert_parity_with_eviction; this adds only the
+        vmapped-pallas_call engine cross-check."""
+        key = jax.random.PRNGKey(9)
+        states = []
+        for impl in ("xla", "pallas"):
+            eng = FusedEngine(rosenbrock_space(2, -3.0, 3.0), _rb_obj,
+                              history_capacity=2048, merge_impl=impl)
+            be = BatchedEngine(eng, 2)
+            states.append(be.run(be.init(key), 4))
+        _eq(states[0].best.qor, states[1].best.qor)
+        _eq(states[0].hist.h0, states[1].hist.h0)
+        _eq(states[0].evals, states[1].evals)
+
+    def test_unsupported_shapes(self):
+        assert not dedup.pallas_merge_supported(1000, 10)   # cap % TILE
+        assert not dedup.pallas_merge_supported(4096, 4097)  # b > TILE
+        rng = np.random.RandomState(0)
+        hist, new, pos = self._mk(rng, 1024, 16, 100, sent_batch=0)
+        with pytest.raises(ValueError):
+            dedup.merge_rows_pallas(hist, new, pos, interpret=True)
+        # merge_history falls back to xla off-TPU / on odd shapes
+        out = dedup.merge_history(hist, new, impl="auto")
+        ref = dedup.merge_rows_xla(hist, new, pos)
+        for a, b in zip(out, ref):
+            assert np.array_equal(np.asarray(a), np.asarray(b),
+                                  equal_nan=True)
+
+
+class TestFusedSurrogateScoring:
+    @pytest.fixture(scope="class")
+    def gp_fit(self):
+        from uptune_tpu.surrogate import gp
+        space = rosenbrock_space(3, -2.0, 2.0)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(32, space.n_surrogate_features),
+                        jnp.float32)
+        y = jnp.asarray(rng.randn(32), jnp.float32)
+        return space, gp.fit(x, y), y
+
+    def test_score_flat_matches_predict(self, gp_fit):
+        """gp.score_flat over a [I, B, F] stack == per-instance
+        predict/EI/LCB: the fused one-dispatch scoring is the same
+        model."""
+        from uptune_tpu.surrogate import gp
+        space, st, y = gp_fit
+        rng = np.random.RandomState(1)
+        xq = jnp.asarray(rng.rand(2, 16, space.n_surrogate_features),
+                         jnp.float32)
+        best = jnp.float32(float(y.min()))
+        ei = gp.score_flat(st, xq, kind="ei", best_y=float(y.min()))
+        lcb = gp.score_flat(st, xq, kind="lcb")
+        mu = gp.score_flat(st, xq, kind="mean")
+        for i in range(2):
+            np.testing.assert_allclose(
+                np.asarray(ei[i]),
+                np.asarray(gp.expected_improvement(st, xq[i], best)),
+                rtol=1e-6, atol=1e-8)
+            np.testing.assert_allclose(
+                np.asarray(lcb[i]),
+                np.asarray(gp.lower_confidence_bound(st, xq[i])),
+                rtol=1e-6, atol=1e-7)
+            np.testing.assert_allclose(
+                np.asarray(mu[i]),
+                np.asarray(gp.predict(st, xq[i])[0]),
+                rtol=1e-6, atol=1e-7)
+        with pytest.raises(ValueError):
+            gp.score_flat(st, xq, kind="ei")   # best_y required
+        with pytest.raises(ValueError):
+            gp.score_flat(st, xq, kind="nope")
+
+    def test_surrogate_eval_fn_drives_batched_engine(self, gp_fit):
+        """The fused GP eval_fn plugs into BatchedEngine: all
+        instances' candidates score in one flat pass and the run is
+        healthy."""
+        space, st, y = gp_fit
+        eng = FusedEngine(space, lambda v, p: jnp.zeros(v.shape[0]),
+                          history_capacity=1 << 10)
+        be = BatchedEngine(eng, 2)
+        fn = surrogate_eval_fn(space, st, kind="ei",
+                               best_y=float(y.min()))
+        s = be.jit_run(3, fn, donate=False)(
+            be.init(jax.random.PRNGKey(0)))
+        assert np.isfinite(be.best_qors(s)).all()
+        assert (np.asarray(s.evals) > 0).all()
+
+    def test_surrogate_eval_fn_sense_orientation(self, gp_fit):
+        """commit re-orients eval_fn output by the engine sign, so the
+        helper must pre-apply the inverse for sense='max' — the raw
+        outputs of the two senses are exact negations."""
+        space, st, y = gp_fit
+        cands = space.random(jax.random.PRNGKey(3), 8)
+        lo = surrogate_eval_fn(space, st, kind="lcb")(cands)
+        hi = surrogate_eval_fn(space, st, kind="lcb",
+                               sense="max")(cands)
+        np.testing.assert_array_equal(np.asarray(lo), -np.asarray(hi))
+
+
+class TestShardMap:
+    def test_sharded_equals_vmap(self, rb_eng, batched4):
+        """shard_map over the instance mesh is semantically INVISIBLE:
+        same per-instance results as the single-device vmap run (the
+        shared batched4 fixture, same key and steps)."""
+        bs = BatchedEngine(rb_eng, 4, mesh=make_instance_mesh(2))
+        ss = bs.run(bs.init(KEY), STEPS)
+        _, sv = batched4
+        _eq(sv.best.qor, ss.best.qor)
+        _eq(sv.best.u, ss.best.u)
+        _eq(sv.evals, ss.evals)
+
+    def test_sharded_exchange_equals_vmap_exchange(self, rb_eng,
+                                                   exchange4):
+        """The exchange collective spans the mesh axis AND the in-shard
+        vmap axis — cooperative results match the unsharded portfolio
+        bitwise."""
+        bs = BatchedEngine(rb_eng, 4, exchange_every=3,
+                           mesh=make_instance_mesh(2))
+        ss = bs.run(bs.init(KEY), STEPS)
+        _, sv = exchange4
+        _eq(sv.best.qor, ss.best.qor)
+        q = np.asarray(ss.best.qor)
+        np.testing.assert_allclose(q, q.min(), atol=0)
+
+    def test_indivisible_instances_raise(self, rb_eng):
+        with pytest.raises(ValueError):
+            BatchedEngine(rb_eng, 3, mesh=make_instance_mesh(2))
+
+
+class TestTuneBatchAPI:
+    def test_tune_batch_and_continue(self):
+        import uptune_tpu as ut
+        space = rosenbrock_space(2, -3.0, 3.0)
+        res = ut.tune_batch(space, _rb_obj, n_instances=2, steps=4,
+                            seed=0, history_capacity=1 << 10)
+        assert len(res.best_configs) == 2
+        assert res.best_qors.shape == (2,)
+        assert res.best_qor == res.best_qors.min()
+        assert set(res.best_config) == {"x0", "x1"}
+        assert (res.acqs > 0).all() and (res.evals > 0).all()
+        before = float(res.best_qors.min())
+        # continuation through tune_batch(state=..., engine=...) must
+        # NOT donate the caller's state (res.state stays readable) and
+        # reuses the compiled program via the returned engine
+        res2 = ut.tune_batch(space, _rb_obj, n_instances=2, steps=4,
+                             seed=0, history_capacity=1 << 10,
+                             state=res.state, engine=res.engine)
+        assert float(np.asarray(res.state.best.qor).min()) == before
+        assert float(res2.best_qors.min()) <= before + 1e-6
+        with pytest.raises(ValueError):
+            ut.tune_batch(space, _rb_obj, n_instances=3, steps=4,
+                          engine=res.engine)
+
+    def test_tune_batch_max_sense(self):
+        import uptune_tpu as ut
+        space = rosenbrock_space(2, -3.0, 3.0)
+        res = ut.tune_batch(space,
+                            lambda v, p: -rosenbrock_device(v),
+                            n_instances=2, steps=5, sense="max",
+                            history_capacity=1 << 10)
+        assert res.best_qor > -0.5   # max of -rosenbrock -> ~0
+
+
+class TestTraceGuardBatched:
+    def test_whole_batched_run_traces_once(self):
+        """ISSUE 6 acceptance: one compiled program for the whole
+        batched run — repeated donated driving adds ZERO retraces
+        under the strict guard."""
+        from uptune_tpu.analysis import TraceGuard
+        with TraceGuard(limit=1, strict=True) as guard:
+            eng = FusedEngine(rosenbrock_space(2, -3.0, 3.0), _rb_obj,
+                              history_capacity=1 << 10)
+            be = BatchedEngine(eng, 2, exchange_every=2)
+            run = be.jit_run(3)
+            st = be.init(jax.random.PRNGKey(0))
+            for _ in range(3):
+                st = run(st)
+        rep = guard.report()
+        assert rep["traces"] == {
+            "BatchedEngine.jit_run.<locals>._run": 1}, rep
+
+
+class TestBenchMultiSmoke:
+    def test_bench_multi_quick(self):
+        """`bench.py --multi --quick --cpu` is the tier-1 smoke for the
+        multi-instance bench path (ISSUE 6 CI satellite): one JSON
+        line, the evidence artifact, and a clean strict trace-guard
+        report."""
+        env = {**os.environ, "PYTHONPATH": REPO,
+               "UT_TRACE_GUARD": "strict"}
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--multi", "--quick", "--cpu"],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=420)
+        assert out.returncode == 0, out.stderr[-2000:]
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["metric"] == "multi_instance_agg_acqs_per_sec_per_chip"
+        assert res["quick"] and res["platform"] == "cpu"
+        assert res["n_instances"] >= 32
+        assert res["value"] > 0
+        assert res["speedup_vs_warm_sequential"] > 0
+        # strict guard: every wrapper in the measured region compiled
+        # exactly once
+        assert res["retraces"]["excess"] == {}, res["retraces"]
+        path = os.path.join(REPO, "BENCH_MULTI.quick.json")
+        assert os.path.exists(path)
+        with open(path) as f:
+            assert json.load(f)["n_instances"] == res["n_instances"]

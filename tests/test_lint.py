@@ -619,7 +619,8 @@ class TestTraceGuard:
 
 
 # ------------------------------------------------------- repo gate
-@pytest.mark.parametrize("package", ["store", "surrogate"])
+@pytest.mark.parametrize("package", ["store", "surrogate", "engine",
+                                     "ops"])
 def test_package_suppression_free(package):
     """Packages on the correctness-critical fast path must be finding-
     AND suppression-free: no '# ut-lint: disable' escape hatch, no
@@ -627,7 +628,10 @@ def test_package_suppression_free(package):
     correctness, ISSUE 4); surrogate/ now runs a concurrent background
     refit thread (ISSUE 5) — a silenced host-sync or retrace hazard
     there would hide a stall on the very path this PR moved off the
-    driver.  lint.sh enforces the same in the pre-commit gate."""
+    driver; engine/ and ops/ carry the fused/batched acquisition loop
+    and its Pallas kernels (ISSUE 6) — a silenced hazard there would
+    invalidate every BENCH_* headline measured through them.  lint.sh
+    enforces the same in the pre-commit gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",
          os.path.join(REPO, "uptune_tpu", package),

@@ -30,7 +30,13 @@ def fitted_env(request):
 
 @pytest.mark.usefixtures("fitted_env")
 class TestShardedScore:
+    """Four kind-variants of ONE sharded-scoring path.  Tier-1 keeps
+    the lcb variant (it exercises both the mean and the variance
+    machinery); the mean/ei/thompson siblings are slow-marked for
+    suite-budget headroom (ISSUE 6 — tier-1 runs ~810s of the 870s
+    timeout)."""
 
+    @pytest.mark.slow
     def test_mean_matches_dense(self):
         got = sharded_gp_score(self.mesh, "eval", self.state,
                                self.feats, kind="mean")
@@ -38,6 +44,7 @@ class TestShardedScore:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_ei_matches_dense(self):
         best = float(jnp.min(self.y))
         got = sharded_gp_score(self.mesh, "eval", self.state,
@@ -54,6 +61,7 @@ class TestShardedScore:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_thompson_shards_draw_independently(self):
         got = np.asarray(sharded_gp_score(
             self.mesh, "eval", self.state, self.feats, kind="thompson",

@@ -36,6 +36,9 @@ _LAZY = {
     "vars": ("uptune_tpu.api.constraint", "vars"),
     "model": ("uptune_tpu.api.tuner", "model"),
     "settings": ("uptune_tpu.api.session", "settings"),
+    # batched multi-instance engine (engine/batched.py): N on-device
+    # tunes of one space as ONE compiled vmapped program
+    "tune_batch": ("uptune_tpu.api.batch", "tune_batch"),
     # EDA report extractors (reference report.py:122-174)
     "vhls": ("uptune_tpu.api.features", "vhls"),
     "quartus": ("uptune_tpu.api.features", "quartus"),

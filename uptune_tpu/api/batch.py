@@ -1,0 +1,72 @@
+"""Library surface for the batched multi-instance engine:
+`uptune_tpu.tune_batch(...)` — N on-device tunes of one space as one
+compiled program (engine/batched.py), returning per-instance results.
+
+The reference's analogue is launching N OpenTuner processes and
+joining their CSV archives; here the whole portfolio is a single
+donate-in-place jitted run.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+
+class BatchTuneResult(NamedTuple):
+    """Per-instance outcomes of one batched run (USER orientation)."""
+    best_config: Dict[str, Any]     # globally best instance's config
+    best_qor: float                 # its QoR
+    best_configs: List[Dict[str, Any]]  # per-instance incumbents
+    best_qors: np.ndarray           # [n_instances]
+    evals: np.ndarray               # [n_instances] novel evaluations
+    acqs: np.ndarray                # [n_instances] candidates processed
+    state: Any                      # final stacked EngineState
+    engine: Any                     # the BatchedEngine (for resuming)
+
+
+def tune_batch(space, objective, n_instances: int, steps: int,
+               seed: int = 0, arms: Optional[Sequence] = None,
+               sense: str = "min", exchange_every: int = 0,
+               history_capacity: int = 1 << 13,
+               eval_fn=None, mesh=None,
+               state=None, engine=None) -> BatchTuneResult:
+    """Run `n_instances` independent on-device tunes of `space` (same
+    space signature => ONE compiled vmapped program) for `steps` fused
+    steps each.
+
+    `objective(vals [B, D], perms) -> [B]` is a pure-JAX device
+    objective over the FLATTENED candidate batch (all instances score
+    in one dispatch); `eval_fn(cands) -> [B]` overrides it with a
+    CandBatch-level evaluator (e.g. engine.surrogate_eval_fn's fused
+    GP scoring).  `exchange_every=k` exchanges the global best across
+    the instance axis every k steps (portfolio-of-portfolios);
+    `mesh` (engine.make_instance_mesh) shards the instance axis over
+    devices.  Pass `state=prev.state, engine=prev.engine` to continue
+    a previous batched run: the engine reuse keeps the already-
+    compiled program (a fresh call would retrace — compiles dominate
+    small runs), and a caller-supplied state is NOT donated
+    (prev.state stays readable); only internally-created states
+    update in place."""
+    import jax
+
+    from ..engine import BatchedEngine, FusedEngine
+
+    be = engine
+    if be is None:
+        eng = FusedEngine(space, objective, arms=arms,
+                          history_capacity=history_capacity, sense=sense)
+        be = BatchedEngine(eng, n_instances,
+                           exchange_every=exchange_every, mesh=mesh)
+    elif be.n_instances != n_instances:
+        raise ValueError(
+            f"engine has {be.n_instances} instances, got "
+            f"n_instances={n_instances}")
+    donate = state is None
+    if state is None:
+        state = be.init(jax.random.PRNGKey(seed))
+    state = be.jit_run(steps, eval_fn, donate=donate)(state)
+    cfg, qor = be.best(state)
+    return BatchTuneResult(
+        cfg, qor, be.best_configs(state), be.best_qors(state),
+        np.asarray(state.evals), np.asarray(state.acqs), state, be)

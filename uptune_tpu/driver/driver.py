@@ -1253,23 +1253,38 @@ class Tuner:
                 return      # already passive (this rule or the user)
             if self._surr_arm or getattr(sm, "_auto_budget", False):
                 return      # user chose arbitration, or already applied
-            prev = (sm.arbitration, sm.propose_batch_parity)
+            prev = (sm.arbitration, sm.propose_batch_parity,
+                    sm.propose_batch)
+            from ..calibrated import BUDGET_CONSTRAINED_OPTS
             sm.arbitration = "bandit"
             sm.propose_batch_parity = False
+            # pin the pull size to the measured recipe: the 0.88x
+            # evidence was captured at the calibrated 8-eval pulls, and
+            # the warning below claims exactly that — a library caller
+            # with a custom propose_batch (e.g. 32) must not silently
+            # get 32-eval pulls under the 8-eval rule (ADVICE r5).
+            # propose_batch == 0 means the plane is DISABLED: leave it
+            # so _wire_surrogate_arm declines and the rule falls back
+            # to passivation instead of resurrecting the plane
+            if sm.propose_batch:
+                sm.propose_batch = \
+                    BUDGET_CONSTRAINED_OPTS["propose_batch"]
             if self._wire_surrogate_arm():
                 sm._auto_budget = prev
                 warnings.warn(
                     f"surrogate switched to BUDGET-CONSTRAINED bandit "
                     f"arbitration for this run: budget {test_limit} "
                     f"evals < {self.space.n_scalar} scalar parameters — "
-                    f"the regime where AUC-arbitrated 8-eval pool pulls "
+                    f"the regime where AUC-arbitrated "
+                    f"{sm.propose_batch}-eval pool pulls "
                     f"are the best measured configuration (0.88x "
                     f"baseline median, BENCHREPORT.md); pass "
                     f"surrogate_opts={{'auto_passive': False}} to "
                     f"override", UserWarning)
                 return
             # can't arbitrate: fall back to passivation (measured-safe)
-            sm.arbitration, sm.propose_batch_parity = prev
+            (sm.arbitration, sm.propose_batch_parity,
+             sm.propose_batch) = prev
             sm.passive = True
             sm._auto_passivated = True
             warnings.warn(
@@ -1289,7 +1304,8 @@ class Tuner:
                 sm._auto_passivated = False
             prev = getattr(sm, "_auto_budget", None)
             if prev:
-                sm.arbitration, sm.propose_batch_parity = prev
+                (sm.arbitration, sm.propose_batch_parity,
+                 sm.propose_batch) = prev
                 sm._auto_budget = None
                 if sm.arbitration != "bandit":
                     # virtual-arm registration is harmless to leave in

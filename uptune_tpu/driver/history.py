@@ -65,10 +65,17 @@ class HistState(NamedTuple):
 
 
 class History:
-    """Static config (capacity) + pure state transforms."""
+    """Static config (capacity) + pure state transforms.
 
-    def __init__(self, capacity: int = 1 << 16):
+    `merge_impl` selects the insert-merge backend ('auto' | 'pallas' |
+    'xla', see ops/dedup.py): 'auto' takes the Pallas kernel on TPU
+    when the shapes qualify and the parity-tested XLA gather+cumsum
+    path everywhere else."""
+
+    def __init__(self, capacity: int = 1 << 16, merge_impl: str = "auto"):
         self.capacity = int(capacity)
+        assert merge_impl in ("auto", "pallas", "xla"), merge_impl
+        self.merge_impl = merge_impl
 
     def init(self) -> HistState:
         cap = self.capacity
@@ -106,14 +113,23 @@ class History:
         return found, qor
 
     def insert(self, st: HistState, hashes: jax.Array, qor: jax.Array,
-               valid: jax.Array) -> HistState:
+               valid: jax.Array, evict_pred=None) -> HistState:
         """Merge a batch of (hash, qor) rows where `valid` is True.
         Overflow beyond capacity evicts the OLDEST live rows first; the
         count of evicted live rows accumulates in `dropped`.
 
         Pipeline (module docstring): [cond] evict-and-compact the
         history in place, sort ONLY the B-row batch, then stable-merge
-        the two h0-sorted runs by scatter.  No full-width sort."""
+        the two h0-sorted runs by scatter.  No full-width sort.
+
+        `evict_pred` (optional traced bool) OVERRIDES the eviction
+        cond's predicate with a conservative one the caller computed —
+        the batched engine passes a batch-level `any instance might
+        overflow` scalar from OUTSIDE its vmap, because a cond on a
+        per-instance (batched) predicate lowers to a select that runs
+        the evict branch every step for every instance.  Must be True
+        whenever overflow > 0; spurious True is safe (evict at
+        overflow 0 is the identity)."""
         cap = self.capacity
         b = hashes.shape[0]
         h0n, h1n = self._clamp(hashes)
@@ -127,33 +143,55 @@ class History:
         overflow = jnp.maximum(total - cap, 0)
 
         def evict(args):
+            """Must stay CHEAP even when it does nothing: under the
+            batched multi-instance engine this whole cond runs as a
+            vmapped select, i.e. the evict branch executes EVERY step
+            for EVERY instance.  The original full-width sort + 4
+            scatter compactions cost more than the rest of the step
+            combined in that regime; the threshold is now a 31-round
+            value-space binary search (compare+count passes, VPU/SIMD
+            friendly) and the compaction is cumsum+searchsorted
+            GATHERS — no sort, no scatter."""
             h0, h1, q, age, k = args
             live = age >= 0
             big = jnp.asarray(0x7FFFFFFF, jnp.int32)
             ages_live = jnp.where(live, age, big)
             # k-th smallest live age = eviction threshold; rows strictly
-            # older all drop, ties at the threshold drop in hash order
-            thr = jnp.sort(ages_live)[jnp.clip(k - 1, 0, cap - 1)]
+            # older all drop, ties at the threshold drop in hash order.
+            # Minimal v with count(ages_live <= v) >= k == sorted[k-1]
+            # (k <= live count always: k = n + n_new - cap <= n)
+            lo = jnp.asarray(0, jnp.int32)
+            hi = big
+            for _ in range(31):
+                mid = lo + (hi - lo) // 2
+                cnt = (ages_live <= mid).sum().astype(jnp.int32)
+                take = cnt >= k
+                lo, hi = (jnp.where(take, lo, mid + 1),
+                          jnp.where(take, mid, hi))
+            thr = lo
             drop_lt = live & (age < thr)
             eq = live & (age == thr)
             m = k - drop_lt.sum().astype(jnp.int32)
             drop_eq = eq & (jnp.cumsum(eq.astype(jnp.int32)) <= m)
             keep = live & ~(drop_lt | drop_eq)
-            # compact the kept rows to the front (stays h0-sorted)
-            dest = jnp.cumsum(keep.astype(jnp.int32)) - 1
-            dest = jnp.where(keep, dest, cap)     # drop out-of-bounds
-            h0c = jnp.full((cap,), _SENTINEL, jnp.uint32) \
-                .at[dest].set(h0, mode="drop")
-            h1c = jnp.full((cap,), _SENTINEL, jnp.uint32) \
-                .at[dest].set(h1, mode="drop")
-            qc = jnp.full((cap,), jnp.inf, jnp.float32) \
-                .at[dest].set(q, mode="drop")
-            ac = jnp.full((cap,), -1, jnp.int32) \
-                .at[dest].set(age, mode="drop")
+            # compact kept rows to the front (stays h0-sorted): output
+            # slot j pulls the row where the keep-cumsum first reaches
+            # j+1; slots past the kept count read the sentinel row
+            cum = jnp.cumsum(keep.astype(jnp.int32))
+            src = jnp.searchsorted(
+                cum, jnp.arange(1, cap + 1, dtype=jnp.int32),
+                side="left").astype(jnp.int32)
+            ok = jnp.arange(cap, dtype=jnp.int32) < cum[-1]
+            src = jnp.clip(src, 0, cap - 1)
+            h0c = jnp.where(ok, h0[src], jnp.uint32(_SENTINEL))
+            h1c = jnp.where(ok, h1[src], jnp.uint32(_SENTINEL))
+            qc = jnp.where(ok, q[src], jnp.inf)
+            ac = jnp.where(ok, age[src], -1)
             return h0c, h1c, qc, ac
 
         h0h, h1h, qh, ah = jax.lax.cond(
-            overflow > 0, evict, lambda a: a[:4],
+            (overflow > 0) if evict_pred is None else evict_pred,
+            evict, lambda a: a[:4],
             (st.h0, st.h1, st.qor, st.age, overflow))
 
         # sort the batch by h0 (B rows — the only sort in the pipeline)
@@ -163,31 +201,15 @@ class History:
 
         # stable two-run merge: old rows before new rows on equal h0
         # (keeps equal-h0 runs contiguous; h1 order within a run is
-        # unspecified by the invariant).  Formulated as GATHERS off one
-        # tiny b-row scatter: the merge-path positions of the B new rows
-        # are marked in a boolean lane, and every output slot then pulls
-        # its row via cumsum-derived indices.  The previous formulation
-        # scattered all 4 value arrays at full width twice each — XLA
-        # lowers big scatters to element loops (measured 25 ms/commit at
-        # cap=2^16 on 1 CPU core, ~1 ms as gathers), and gathers also
-        # vectorize better on TPU.
-        pos_new = (jnp.arange(b, dtype=jnp.int32)
-                   + jnp.searchsorted(h0h, h0s, side="right"
-                                      ).astype(jnp.int32))
-        is_new = jnp.zeros((cap + b,), bool).at[pos_new].set(True)
-        idx_new = jnp.cumsum(is_new.astype(jnp.int32)) - 1
-        idx_hist = jnp.arange(cap + b, dtype=jnp.int32) - idx_new - 1
-        idx_new = jnp.clip(idx_new, 0, b - 1)
-        idx_hist = jnp.clip(idx_hist, 0, cap - 1)
-
-        def mrg(hist_v, new_v):
-            return jnp.where(is_new, new_v[idx_new],
-                             hist_v[idx_hist])[:cap]
-
-        h0m = mrg(h0h, h0s)
-        h1m = mrg(h1h, h1s)
-        qm = mrg(qh, qs)
-        am = mrg(ah, ags)
+        # unspecified by the invariant).  ops/dedup.py owns the merge:
+        # a tiled Pallas kernel on TPU (one-hot MXU gathers over VMEM
+        # windows, all four columns in one packed pass), the PR 2
+        # gather+cumsum formulation elsewhere — parity-tested in
+        # tests/test_batched.py.
+        from ..ops import dedup as dedup_ops  # local: avoid cycle
+        h0m, h1m, qm, am = dedup_ops.merge_history(
+            (h0h, h1h, qh, ah), (h0s, h1s, qs, ags),
+            impl=self.merge_impl)
 
         n = jnp.minimum(total, cap)
         return HistState(h0m, h1m, qm, n, am, st.step + 1,

@@ -1,1 +1,3 @@
+from .batched import (BatchedEngine, exchange_best,  # noqa: F401
+                      make_instance_mesh, surrogate_eval_fn)
 from .fused import DeviceObjective, EngineState, FusedEngine, default_arms  # noqa: F401

@@ -1,0 +1,301 @@
+"""Batched multi-instance engine: N independent tunes as ONE program.
+
+The reference scales search by launching many OpenTuner *processes*
+that exchange results through SQLite/CSV archives (PAPER.md L4/L5).
+On a TPU that shape wastes the chip: BENCH_TPU.json records the fused
+single-instance engine at MXU util 6e-06 / HBM util 9e-4 — ~0.0001%
+of a v5 lite, because one tune's batches are tiny next to the
+hardware.  This module stacks `EngineState` along a leading INSTANCE
+axis and runs the whole portfolio-of-portfolios as one vmapped,
+donate-in-place program:
+
+* **N independent tunes** of the same space signature (or N seeds of
+  one tune): `jax.vmap` over `FusedEngine.propose`/`commit`, one
+  compiled program, ONE trace under `UT_TRACE_GUARD=strict`, per-
+  instance RNG streams / technique states / dedup histories — the
+  device-resident analogue of the reference's per-instance DBs.
+* **Fused scoring**: the evaluation between the two vmapped halves is
+  NOT vmapped — all instances' candidates flatten to one [N*B] batch
+  and score in a single dispatch (for surrogate objectives this turns
+  N small GP scoring matmuls into one MXU-filling [N*B, train] pass —
+  `surrogate_eval_fn` / gp.score_flat).
+* **Periodic on-device best-exchange** across the instance axis
+  (`exchange_every=k`): the multi-start portfolio becomes cooperative,
+  reusing the sharded engine's lexicographic pmin + one-hot psum
+  collective over the vmap axis name (the epoch-wise `sync` of the
+  reference's multi-instance search, opentuner/api.py:87-104).
+* **shard_map scale-out**: with an instance mesh the same step runs
+  per-device over local instances, and the exchange collective spans
+  both the mesh axis and the in-device vmap axis.
+
+`bench.py --multi` measures the aggregate acquisition throughput and
+writes BENCH_MULTI.json; `uptune_tpu.tune_batch` is the library
+surface.
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..space.spec import CandBatch
+from ..techniques.base import Best
+from .fused import EngineState, FusedEngine
+
+# axis names: the in-program vmap axis over instances, and the device
+# mesh axis shard_map splits the instance axis over
+VMAP_AXIS = "inst"
+MESH_AXIS = "idev"
+
+
+def _strong(tree):
+    """Strip weak_type from every array leaf: technique init states
+    carry weak-typed python-constant leaves, which become strong after
+    one run — without this the second jit_run call on a rebound state
+    would RETRACE (driver.py learned the same lesson in PR 2; the
+    strict trace guard holds this engine to one trace per wrapper)."""
+    return jax.tree.map(
+        lambda x: (x + jnp.zeros((), x.dtype)
+                   if getattr(x, "weak_type", False) else x), tree)
+
+
+def make_instance_mesh(n_devices: Optional[int] = None,
+                       devices=None) -> Mesh:
+    """1-D ('idev',) mesh over the first n_devices local devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (MESH_AXIS,))
+
+
+def exchange_best(best: Best, axes) -> Best:
+    """Global-best broadcast over the named axes (vmap instance axis
+    and/or mesh axis): lexicographic (qor, instance-rank) argmin, then
+    a one-hot psum broadcast — ShardedEngine._exchange generalized to
+    arbitrary axis-name tuples."""
+    axes = tuple(axes)
+    qmin = jax.lax.pmin(best.qor, axes)
+    rank = jnp.asarray(0, jnp.int32)
+    for ax in axes:  # row-major rank over the axis product
+        rank = rank * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+    big = jnp.asarray(1 << 30, jnp.int32)
+    winner = jax.lax.pmin(
+        jnp.where(best.qor == qmin, rank, big), axes)
+    i_am = (rank == winner) & jnp.isfinite(qmin)
+    u = jax.lax.psum(jnp.where(i_am, best.u, 0.0), axes)
+    perms = tuple(jax.lax.psum(jnp.where(i_am, p, 0), axes)
+                  for p in best.perms)
+    # keep the local best when nothing finite exists yet
+    return Best(
+        jnp.where(jnp.isfinite(qmin), u, best.u),
+        tuple(jnp.where(jnp.isfinite(qmin), p, lp)
+              for p, lp in zip(perms, best.perms)),
+        qmin)
+
+
+def surrogate_eval_fn(space, gp_state, kind: str = "ei",
+                      best_y=None, beta: float = 2.0,
+                      n_cont: Optional[int] = None, n_cat: int = 0,
+                      sense: str = "min"):
+    """A flat-batch eval_fn scoring candidates against a fitted
+    GPState so that the ENGINE prefers: low posterior mean ('mean'),
+    high expected improvement ('ei'), or low mu - beta*sd ('lcb').
+    Because BatchedEngine evaluates the FLATTENED [N*B] batch, all
+    instances share one scoring pass — one [N*B, train] cross-kernel
+    matmul (Pallas-tiled past PALLAS_MIN_POOL) instead of N separate
+    dispatches.
+
+    `sense` MUST match the engine's: eval_fn output is re-oriented by
+    commit (`qor = sign * raw` — the eval_fn slot carries USER-level
+    values), so this helper pre-applies the inverse.  The model is
+    assumed fitted on engine-oriented (minimized) QoR, as the driver
+    trains it."""
+    assert sense in ("min", "max"), sense
+    sgn = 1.0 if sense == "min" else -1.0
+    from ..surrogate import gp as gp_mod
+
+    def eval_fn(cands: CandBatch) -> jax.Array:
+        feats = space.surrogate_transform(space.features(cands))
+        s = gp_mod.score_flat(gp_state, feats, kind=kind, best_y=best_y,
+                              beta=beta, n_cont=n_cont, n_cat=n_cat)
+        return sgn * (-s if kind == "ei" else s)
+
+    return eval_fn
+
+
+class BatchedEngine:
+    """A FusedEngine vectorized over a leading instance axis.
+
+    n_instances independent searches (same Space + arms => same
+    compiled step) run as one program; `exchange_every=k` turns
+    multi-start into a cooperative portfolio (on-device best exchange
+    every k steps); `mesh` (a ('idev',) Mesh) shards the instance axis
+    across devices with shard_map."""
+
+    def __init__(self, engine: FusedEngine, n_instances: int,
+                 exchange_every: int = 0, mesh: Optional[Mesh] = None):
+        if n_instances < 1:
+            raise ValueError(f"n_instances must be >= 1: {n_instances}")
+        self.engine = engine
+        self.n_instances = int(n_instances)
+        self.exchange_every = int(exchange_every)
+        self.mesh = mesh
+        if mesh is not None:
+            n_dev = mesh.shape[MESH_AXIS]
+            if self.n_instances % n_dev:
+                raise ValueError(
+                    f"n_instances {n_instances} not divisible by "
+                    f"mesh axis size {n_dev}")
+        self._compiled: dict = {}
+
+    # -- state management ---------------------------------------------------
+    def instance_keys(self, key: jax.Array) -> jax.Array:
+        """The per-instance PRNG keys init() derives — exposed so
+        matched-seed sequential runs can start FusedEngine.init from
+        the exact same streams."""
+        return jax.random.split(key, self.n_instances)
+
+    def init(self, key: jax.Array) -> EngineState:
+        """Stacked per-instance EngineStates ([n_instances] leading
+        axis), placed on the mesh when sharded."""
+        state = _strong(jax.vmap(self.engine.init)(self.instance_keys(key)))
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, P(MESH_AXIS))
+            state = jax.tree.map(
+                lambda x: jax.device_put(x, sharding), state)
+        return state
+
+    # -- the batched step ---------------------------------------------------
+    def _eval_flat(self, flat: CandBatch) -> jax.Array:
+        eng = self.engine
+        return eng.objective(eng.space.decode_scalars(flat.u), flat.perms)
+
+    def _step(self, state: EngineState, t: jax.Array, axes,
+              eval_fn=None) -> EngineState:
+        """propose (vmapped) -> score (ONE flat fused dispatch) ->
+        commit (vmapped, with the optional exchange collective)."""
+        eng = self.engine
+        tstates, cands, keys = jax.vmap(eng.propose)(state)
+        i_local, b = cands.u.shape[0], cands.u.shape[1]
+        flat = CandBatch(
+            cands.u.reshape(i_local * b, -1),
+            tuple(p.reshape(i_local * b, p.shape[-1])
+                  for p in cands.perms))
+        raw = (eval_fn or self._eval_flat)(flat).reshape(i_local, b)
+
+        # batch-level eviction gate, computed OUTSIDE the vmap so the
+        # insert cond keeps a real (unbatched) predicate: a batched
+        # predicate lowers cond to select and the evict branch would
+        # run every step for every instance (identity or not).
+        # Conservative (any instance COULD overflow) is exact in
+        # effect: evict at overflow 0 is the identity.
+        evict_pred = None
+        if eng.dedup:
+            evict_pred = jnp.any(
+                state.hist.n + b > eng.history.capacity)
+
+        exchange = None
+        if self.exchange_every > 0:
+            k = self.exchange_every
+
+            def exchange(best):
+                ex = exchange_best(best, axes)
+                do = (t + 1) % k == 0
+                return jax.tree.map(
+                    lambda a, bs: jnp.where(do, a, bs), ex, best)
+
+        def commit(s, ts, c, q, kk):
+            return eng.commit(s, ts, c, q, kk, exchange=exchange,
+                              evict_pred=evict_pred)
+
+        return jax.vmap(commit, axis_name=VMAP_AXIS)(
+            state, tstates, cands, raw, keys)
+
+    def _run_local(self, state: EngineState, n_steps: int, axes,
+                   eval_fn=None) -> EngineState:
+        def body(s, t):
+            return self._step(s, t, axes, eval_fn), None
+        out, _ = jax.lax.scan(
+            body, state, jnp.arange(n_steps, dtype=jnp.int32))
+        return out
+
+    # -- compiled entries ---------------------------------------------------
+    def jit_run(self, n_steps: int, eval_fn=None, donate: bool = True):
+        """The jitted n_steps program (memoized per (n_steps, donate,
+        eval_fn) so repeated driving never retraces).  donate=True
+        updates the stacked histories/technique states in place — the
+        caller must rebind and never reuse the donated input.
+
+        `eval_fn` is part of the memo key by OBJECT IDENTITY (same
+        contract as jax.jit): pass the SAME callable across calls.
+        Re-wrapping a fresh closure per call (e.g. a new
+        surrogate_eval_fn every refit) recompiles each time and the
+        memo retains every compiled program plus whatever the closure
+        captured."""
+        sig = (n_steps, donate, eval_fn)
+        fn = self._compiled.get(sig)
+        if fn is not None:
+            return fn
+        if self.mesh is None:
+            def _run(s):
+                return self._run_local(s, n_steps, (VMAP_AXIS,), eval_fn)
+        else:
+            from ..parallel.sharded import shard_map
+
+            def _local(s):
+                return self._run_local(s, n_steps,
+                                       (MESH_AXIS, VMAP_AXIS), eval_fn)
+
+            _run = shard_map(_local, mesh=self.mesh,
+                             in_specs=(P(MESH_AXIS),),
+                             out_specs=P(MESH_AXIS), check_rep=False)
+        fn = jax.jit(_run, donate_argnums=(0,) if donate else ())
+        self._compiled[sig] = fn
+        return fn
+
+    def run(self, state: EngineState, n_steps: int,
+            eval_fn=None) -> EngineState:
+        """Non-donating convenience entry (tests / interactive use)."""
+        return self.jit_run(n_steps, eval_fn, donate=False)(state)
+
+    def run_traced(self, state: EngineState, n_steps: int
+                   ) -> Tuple[EngineState, jax.Array]:
+        """Like run() but also returns the per-instance best-so-far
+        trace [n_steps, n_instances] in USER orientation.  Unsharded
+        only (a scan output's per-step collective layout under
+        shard_map is not worth the complexity for an orientation
+        tool)."""
+        if self.mesh is not None:
+            raise ValueError("run_traced is unsharded-only")
+        sign = self.engine.sign
+
+        def body(s, t):
+            s = self._step(s, t, (VMAP_AXIS,))
+            return s, sign * s.best.qor
+
+        return jax.lax.scan(
+            body, state, jnp.arange(n_steps, dtype=jnp.int32))
+
+    # -- host-side results --------------------------------------------------
+    def best_qors(self, state: EngineState) -> np.ndarray:
+        """[n_instances] per-instance best QoR in USER orientation
+        (host sync: the reporting boundary, never jit-reachable)."""
+        return self.engine.sign * np.asarray(state.best.qor)
+
+    def best_config(self, state: EngineState, i: int) -> dict:
+        """Instance i's incumbent configuration."""
+        best = jax.tree.map(lambda x: x[i], state.best)
+        return self.engine.space.to_configs(best.as_batch(1))[0]
+
+    def best_configs(self, state: EngineState) -> List[dict]:
+        return self.engine.space.to_configs(
+            CandBatch(state.best.u, state.best.perms))
+
+    def best(self, state: EngineState) -> Tuple[dict, float]:
+        """(config, qor) of the globally best instance."""
+        qors = self.best_qors(state)
+        i = int(np.argmin(self.engine.sign * qors))
+        return self.best_config(state, i), float(qors[i])

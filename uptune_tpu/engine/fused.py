@@ -68,7 +68,7 @@ class FusedEngine:
     def __init__(self, space: Space, objective: DeviceObjective,
                  arms: Optional[Sequence[Technique]] = None,
                  history_capacity: int = 1 << 15, dedup: bool = True,
-                 sense: str = "min"):
+                 sense: str = "min", merge_impl: str = "auto"):
         assert sense in ("min", "max")
         self.space = space
         self.sign = 1.0 if sense == "min" else -1.0
@@ -83,7 +83,7 @@ class FusedEngine:
             raise ValueError("no arm supports this space")
         self.batches = [t.natural_batch(space) for t in self.arms]
         self.total_batch = sum(self.batches)
-        self.history = History(history_capacity)
+        self.history = History(history_capacity, merge_impl=merge_impl)
         self.dedup = dedup
 
     # ------------------------------------------------------------------
@@ -98,15 +98,13 @@ class FusedEngine:
             jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.int32))
 
     # ------------------------------------------------------------------
-    def step(self, state: EngineState, eval_fn=None,
-             exchange=None) -> EngineState:
-        """One fused acquisition step (pure; jit/scan-able).
-
-        `eval_fn(cands) -> qor` overrides the plain objective call (the
-        sharded engine injects a batch-sharded evaluator); `exchange(best)
-        -> best` is the cross-replica best-exchange collective (the
-        epoch-wise `sync` of the reference's multi-instance search,
-        opentuner/api.py:87-104) — identity when absent."""
+    def propose(self, state: EngineState):
+        """The proposal half of one step: every arm emits its batch and
+        the batches concatenate (pure; jit/vmap-able).  Returns
+        `(new_tstates, cands, key)` for `commit()` — the split exists so
+        the batched multi-instance engine can vmap proposal, evaluate
+        ALL instances' candidates in one flat fused scoring pass, and
+        vmap the commit, instead of dispatching per instance."""
         space = self.space
         key, *karms = jax.random.split(state.key, len(self.arms) + 1)
 
@@ -118,21 +116,47 @@ class FusedEngine:
             cands_list.append(c)
         cands = (concat_cands(cands_list) if len(cands_list) > 1
                  else cands_list[0])
+        return tuple(new_tstates), cands, key
 
+    # ------------------------------------------------------------------
+    def step(self, state: EngineState, eval_fn=None,
+             exchange=None) -> EngineState:
+        """One fused acquisition step (pure; jit/scan-able).
+
+        `eval_fn(cands) -> qor` overrides the plain objective call (the
+        sharded engine injects a batch-sharded evaluator); `exchange(best)
+        -> best` is the cross-replica best-exchange collective (the
+        epoch-wise `sync` of the reference's multi-instance search,
+        opentuner/api.py:87-104) — identity when absent."""
+        new_tstates, cands, key = self.propose(state)
         if eval_fn is None:
-            raw = self.objective(space.decode_scalars(cands.u), cands.perms)
+            raw = self.objective(
+                self.space.decode_scalars(cands.u), cands.perms)
         else:
             raw = eval_fn(cands)
+        return self.commit(state, new_tstates, cands, raw, key, exchange)
+
+    # ------------------------------------------------------------------
+    def commit(self, state: EngineState, new_tstates, cands: CandBatch,
+               raw: jax.Array, key: jax.Array,
+               exchange=None, evict_pred=None) -> EngineState:
+        """The commit half of one step: orient + clean the measured QoR,
+        dedup against history, fold the batch into the best, attribute
+        per-arm credit, and run every arm's observe.  `raw` is the
+        UN-oriented objective value for `cands` (propose()'s output);
+        `evict_pred` forwards to History.insert (the batched engine's
+        unbatched eviction gate)."""
         qor = self.sign * raw
         qor = jnp.where(jnp.isfinite(qor), qor, jnp.inf).astype(jnp.float32)
 
         if self.dedup:
-            hashes = space.hash_batch(cands)
+            hashes = self.space.hash_batch(cands)
             found, known = self.history.contains(state.hist, hashes)
             src = dup_source(hashes)
             first = src == jnp.arange(hashes.shape[0])
             novel = first & ~found
-            hist = self.history.insert(state.hist, hashes, qor, novel)
+            hist = self.history.insert(state.hist, hashes, qor, novel,
+                                       evict_pred=evict_pred)
             n_new = novel.sum().astype(jnp.int32)
         else:
             hist = state.hist
@@ -155,7 +179,7 @@ class FusedEngine:
             hit = (arm_best < prev_best) & (arm_best <= step_min)
             arm_hits = arm_hits.at[i].add(hit.astype(jnp.int32))
             tstates_out.append(
-                t.observe(space, st2, cands[sl], cq, best))
+                t.observe(self.space, st2, cands[sl], cq, best))
             off += b
 
         return EngineState(
@@ -201,7 +225,8 @@ class FusedEngine:
     def best_qor(self, state: EngineState) -> float:
         # intentional host sync: this is the reporting boundary, called
         # once after run() — never from inside the fused/scanned step.
-        # R001 does not fire here today (best_qor is not jit-reachable);
-        # the pragma is precautionary, guarding a future caller that
-        # pulls this into a traced path
-        return float(self.sign * state.best.qor)  # ut-lint: disable=R001
+        # R001 does not fire here (best_qor is not jit-reachable), and
+        # engine/ is suppression-free (scripts/lint.sh), so no pragma:
+        # a future caller that pulls this into a traced path will be
+        # flagged loudly instead of silently waived
+        return float(self.sign * state.best.qor)

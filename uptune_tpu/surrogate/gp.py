@@ -447,6 +447,46 @@ def thompson(state: GPState, xq: jax.Array, key: jax.Array,
     return mu + sd * jax.random.normal(key, mu.shape)
 
 
+def score_flat(state: GPState, xq: jax.Array, kind: str = "mean",
+               best_y=None, beta: float = 2.0,
+               n_cont: Optional[int] = None, n_cat: int = 0,
+               interpret: bool = None,
+               pallas_min: Optional[int] = None) -> jax.Array:
+    """Score a query batch of ANY leading shape [..., F] as ONE flat
+    [prod(leading), F] pass — the fused-scoring entry the batched
+    multi-instance engine uses: N instances' candidate batches reshape
+    to a single cross-kernel matmul (filling the MXU) instead of N
+    per-instance dispatches, and past PALLAS_MIN_POOL flat rows the
+    Pallas tiled kernel scores without the [B, N] HBM intermediate.
+
+    kind: 'mean' (posterior mean), 'ei' (expected improvement vs
+    `best_y` — required), or 'lcb' (mu - beta*sd).  Returns scores in
+    the leading shape of `xq`; `n_cont`/`n_cat` MUST match the fit."""
+    lead = xq.shape[:-1]
+    flat = xq.reshape((-1, xq.shape[-1]))
+    from . import pallas_score  # local: pallas_score imports gp lazily
+    if pallas_min is None:
+        pallas_min = pallas_score.PALLAS_MIN_POOL
+    fused = flat.shape[0] >= pallas_min
+    if kind == "mean":
+        out = (pallas_score.gp_mean_scores(
+                   state, flat, interpret, n_cont, n_cat) if fused
+               else predict(state, flat, n_cont, n_cat)[0])
+    elif kind in ("ei", "lcb"):
+        mu, sd = (pallas_score.gp_mean_var_scores(
+                      state, flat, interpret, n_cont, n_cat) if fused
+                  else predict(state, flat, n_cont, n_cat))
+        if kind == "ei":
+            if best_y is None:
+                raise ValueError("kind='ei' needs best_y")
+            out = ei_from_moments(mu, sd, jnp.float32(best_y))
+        else:
+            out = mu - beta * sd
+    else:
+        raise ValueError(f"unknown kind {kind!r}")
+    return out.reshape(lead)
+
+
 def subsample(key: jax.Array, x: jax.Array, y: jax.Array,
               max_points: int) -> Tuple[jax.Array, jax.Array]:
     """Best-biased subsample: keep the best half deterministically, fill

@@ -862,8 +862,28 @@ class SurrogateManager:
                 nf = jnp.exp2(jax.random.uniform(
                     kf1, (n_flip, 1), minval=0.0,
                     maxval=float(np.log2(max_flips))))
+                # per-lane probability nf * flip_p, clipped at 1 with
+                # the truncated mass redistributed over eligible
+                # lanes proportional to their HEADROOM (1 - p): with
+                # flip BIAS a high-sensitivity lane can exceed 1 at
+                # large nf, and silent saturation would deflate the
+                # expected flip count below the nominal nf (ADVICE
+                # r5).  Headroom-proportional shares can never
+                # re-saturate a lane while headroom remains, so the
+                # expected count is preserved EXACTLY whenever
+                # over <= total headroom (else every eligible lane
+                # saturates — the achievable maximum).  Unsaturated
+                # rows pass through bitwise unchanged (over == 0).
+                p_flip = nf * flip_p[None, :]
+                over = jnp.clip(p_flip - 1.0, 0.0).sum(-1, keepdims=True)
+                p_flip = jnp.minimum(p_flip, 1.0)
+                room = jnp.where(flip_p[None, :] > 0, 1.0 - p_flip, 0.0)
+                p_flip = jnp.minimum(
+                    p_flip + over * room
+                    / jnp.maximum(room.sum(-1, keepdims=True), 1e-9),
+                    1.0)
                 sel = (jax.random.uniform(kf2, (n_flip, space.n_scalar))
-                       < nf * flip_p[None, :]) & (cat_row > 0)
+                       < p_flip) & (cat_row > 0)
                 vals = space.decode_scalars(best_u)          # [D] codes
                 ncodes = space.vhi + 1.0
                 off = 1.0 + jnp.floor(

@@ -191,8 +191,17 @@ def archive_rows(space, path: str):
                 continue
             if "u" not in rec or "qor" not in rec:
                 continue
+            pm_rec = rec.get("perms", [])
+            if (len(pm_rec) != len(space.perm_sizes)
+                    or any(len(p) != s
+                           for p, s in zip(pm_rec, space.perm_sizes))):
+                # a row lacking (or short on) its perm blocks cannot be
+                # reassembled into a CandBatch on a permutation space —
+                # skip it like any other malformed row instead of
+                # raising IndexError at stacking time (ADVICE r5)
+                continue
             us.append(rec["u"])
-            perms.append(rec.get("perms", []))
+            perms.append(pm_rec)
             qors.append(float(rec["qor"]))
     if not us:
         return (np.zeros((0, space.n_surrogate_features), np.float32),
