@@ -173,6 +173,242 @@ def _utilization(device_kind: str, flops_per_s, bytes_per_s):
     return {}
 
 
+def obs_main() -> None:
+    """`bench.py --obs`: the observability-plane overhead benchmark —
+    the cost of the instrumentation itself, in both of its states
+    (ISSUE 7 hard requirement).  Writes BENCH_OBS.json.
+
+    Phase 1 (disabled path): the exact BENCH_DRIVER protocol — warm
+    200 trials, then timed ask/tell against the instant dummy
+    evaluator — with every obs call site present but tracing OFF.
+    Compared against the committed BENCH_DRIVER.json 4607.9 asks/s:
+    the disabled path must be indistinguishable from the
+    pre-instrumentation driver (one module-flag check per call site).
+
+    Phase 2 (enabled path): same protocol, same process, tracing ON
+    with the full span/counter stream recording into the per-thread
+    rings.  Must hold >= 95% of the disabled-path rate.
+
+    Phase 3 (full runs only): the async-surrogate warm-window check —
+    the PR 5 protocol (rosenbrock-2d, calibrated opts at max_points
+    512, 2 virtual devices, lockstep tells) WITH tracing enabled; the
+    learning-attributable warm refit-window tell p95
+    (StepStats.t_refit) must stay in the BENCH_SURROGATE.json ~1.6 ms
+    class, proving tracing does not tax the tell path the async plane
+    just cleared.  This phase's trace is exported as the committed
+    example artifact (exp_archives/obs_trace_example.json) — driver
+    lane + refit-worker lane, validated by the schema test.
+
+    Run under UT_TRACE_GUARD=strict to also prove tracing adds no
+    retraces."""
+    quick = "--quick" in sys.argv
+    from uptune_tpu.utils.platform_guard import force_cpu
+    # 2 virtual devices: phase 3 is the async-surrogate deployment
+    # shape (driver on 0, background fits on 1); phases 1-2 only use
+    # device 0 (identical to the BENCH_DRIVER box's nproc=2)
+    force_cpu(2)
+    import jax  # noqa: F401  (backend must init after force_cpu)
+    import numpy as np
+
+    from uptune_tpu import obs
+    from uptune_tpu.analysis.trace_guard import guard_from_env
+
+    pct = lambda a, p: (round(float(np.percentile(a, p)), 3)  # noqa: E731
+                        if len(a) else None)
+
+    # one guard per tuner-building phase (the cache_main rule): phase 3
+    # builds a SECOND Tuner whose per-arm wrappers come from the same
+    # code objects as phase 1's — under ONE guard that reads as
+    # rebuild churn even though each tuner compiles once
+    with guard_from_env() as guard:
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.workloads import rosenbrock_space
+
+        space = rosenbrock_space(8, -3.0, 3.0)
+        tuner = Tuner(space, None, seed=0)
+        lats = []
+
+        def drain(n):
+            done = 0
+            while done < n:
+                for tr in tuner.ask(min_trials=1):
+                    t0 = time.perf_counter()
+                    tuner.tell(tr, float((tr.gid * 2654435761) % 1000))
+                    lats.append(time.perf_counter() - t0)
+                    done += 1
+            return done
+
+        # full-mode window matches the BENCH_DRIVER steady phase (2000
+        # trials) so the cross-artifact asks/s comparison is
+        # like-for-like in measurement length
+        window = 500 if quick else 2000
+        reps = 3
+        drain(200)                      # compile warmup (both phases)
+
+        def timed_window():
+            lats.clear()
+            t0 = time.perf_counter()
+            n = drain(window)
+            dt = time.perf_counter() - t0
+            return (n / dt, dt, n,
+                    pct([x * 1e3 for x in lats], 50),
+                    pct([x * 1e3 for x in lats], 95))
+
+        # ALTERNATING disabled/enabled windows, best-of-reps per mode:
+        # this box's throughput swings ~2x with co-tenant load
+        # (BENCH_r0* history), so back-to-back single phases would
+        # measure the weather — interleaving puts both modes under the
+        # same bursts and min-wall picks each mode's uncontended rate
+        # (the same best-of-reps rule as the engine benches)
+        d_reps, e_reps = [], []
+        events_recorded = events_dropped = 0
+        for _ in range(reps):
+            d_reps.append(timed_window())
+            obs.enable(capacity=1 << 18)
+            e_reps.append(timed_window())
+            snap = obs.snapshot()
+            events_recorded = len(snap["events"])
+            events_dropped = sum(snap["dropped"].values())
+            obs.reset()
+
+        def mode_result(rs):
+            best = max(rs, key=lambda r: r[0])
+            return {"asks_per_sec": round(best[0], 1),
+                    "wall_s": round(best[1], 4), "trials": best[2],
+                    "tell_p50_ms": best[3], "tell_p95_ms": best[4],
+                    "rep_asks_per_sec": [round(r[0], 1) for r in rs]}
+
+        disabled = mode_result(d_reps)
+        enabled = mode_result(e_reps)
+        enabled["events_recorded"] = events_recorded
+        enabled["events_dropped"] = events_dropped
+
+    surro = None
+    with guard_from_env() as guard3:
+        if not quick:
+            # phase 3: PR 5 warm-window protocol WITH tracing enabled
+            from uptune_tpu.calibrated import CALIBRATED_OPTS
+            from uptune_tpu.workloads import rosenbrock_objective
+            sopts = dict(CALIBRATED_OPTS, max_points=512,
+                         async_refit=True)
+            obj = rosenbrock_objective(2)
+            sp2 = rosenbrock_space(2, -2.048, 2.048)
+            obs.enable(capacity=1 << 18)
+            t2 = Tuner(sp2, None, seed=0, surrogate="gp",
+                       surrogate_opts=sopts)
+            sm = t2.surrogate
+            blocked, windows, warm = [], [], []
+            seen_buckets = set()
+            done = 0
+            trials3 = 600
+            while done < trials3:
+                for tr in t2.ask(min_trials=1):
+                    if done >= trials3:
+                        t2.cancel(tr)
+                        continue
+                    starts0 = sm.refits_started
+                    stats = t2.tell(tr, float(obj([tr.config])[0]))
+                    blocked.append(stats.t_refit * 1e3
+                                   if stats is not None else 0.0)
+                    w = sm.refits_started > starts0
+                    windows.append(w)
+                    if w:
+                        bkt = sm.fit_bucket()
+                        warm.append(bkt in seen_buckets)
+                        seen_buckets.add(bkt)
+                    else:
+                        warm.append(False)
+                    done += 1
+            t2.close()
+            wb = [b for b, w in zip(blocked, warm) if w]
+            surro = {
+                "tells": done,
+                "refit_windows": int(sum(windows)),
+                "warm_refit_windows": int(sum(warm)),
+                "refit_blocked_warm_p50_ms": pct(wb, 50),
+                "refit_blocked_warm_p95_ms": pct(wb, 95),
+                "full_fits_published": sm.refits,
+                "incremental_updates": sm.incr_updates,
+            }
+            # the committed example trace: driver lane + refit-worker
+            # lane over a real async tune (schema-validated by
+            # tests/test_obs.py against this exact file)
+            trace_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "exp_archives", "obs_trace_example.json")
+            doc = obs.write_trace(trace_path, extra={
+                "protocol": "bench.py --obs phase 3 (async surrogate, "
+                            "rosenbrock-2d, 600 lockstep tells)"})
+            surro["trace_file"] = "exp_archives/obs_trace_example.json"
+            surro["trace_events"] = len(doc["traceEvents"])
+            obs.reset()
+
+    drv_baseline = None
+    drv = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "BENCH_DRIVER.json")
+    try:
+        with open(drv) as f:
+            drv_baseline = json.load(f)["value"]
+    except (OSError, ValueError, KeyError):
+        pass
+    surro_baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "BENCH_SURROGATE.json")) as f:
+            surro_baseline = json.load(
+                f)["async"]["refit_blocked_ms"]["warm_window_p95"]
+    except (OSError, ValueError, KeyError):
+        pass
+
+    ratio = round(enabled["asks_per_sec"]
+                  / max(disabled["asks_per_sec"], 1e-9), 4)
+    result = {
+        "metric": "obs_enabled_over_disabled_asks_ratio",
+        # headline: enabled-tracing throughput as a fraction of the
+        # same process's disabled-path throughput (the honest
+        # like-for-like; cross-run baselines are reported alongside)
+        "value": ratio,
+        "unit": "enabled asks/s / disabled asks/s (>= 0.95 required)",
+        "platform": "cpu",
+        "quick": quick,
+        "nproc": os.cpu_count(),
+        "protocol": {
+            "space": "rosenbrock-8d", "seed": 0,
+            "window_trials": window, "reps_per_mode": reps,
+            "phases": "1+2 interleaved: BENCH_DRIVER ask/tell "
+                      "protocol in alternating disabled/enabled "
+                      "windows (obs call sites always present), "
+                      "best-of-reps per mode so co-tenant load bursts "
+                      "hit both modes alike; 3 (full runs): PR 5 "
+                      "async-surrogate warm-window protocol with "
+                      "tracing enabled",
+        },
+        "disabled": disabled,
+        "enabled": enabled,
+        "driver_asks_per_sec_baseline": drv_baseline,
+        "disabled_vs_driver_baseline": (
+            round(disabled["asks_per_sec"] / drv_baseline, 4)
+            if drv_baseline else None),
+        "enabled_vs_driver_baseline": (
+            round(enabled["asks_per_sec"] / drv_baseline, 4)
+            if drv_baseline else None),
+    }
+    if surro is not None:
+        result["surrogate_traced"] = surro
+        result["surrogate_warm_p95_baseline_ms"] = surro_baseline
+    if guard.enabled:
+        result["retraces"] = {"driver_phases": guard.report(),
+                              "surrogate_phase": guard3.report()}
+    name = "BENCH_OBS.quick.json" if quick else "BENCH_OBS.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        name)
+    with open(path, "w") as f:
+        json.dump({**result, "captured_unix": time.time()}, f, indent=1)
+    print(f"bench: observability evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps(result))
+
+
 def driver_main() -> None:
     """`bench.py --driver`: the driver-plane microbenchmark — asks/sec
     through the host Tuner's ask()/tell() surface against an instant
@@ -186,7 +422,9 @@ def driver_main() -> None:
     force_cpu(1)
     import jax  # noqa: F401  (backend must init after force_cpu)
 
+    from uptune_tpu import obs
     from uptune_tpu.analysis.trace_guard import guard_from_env
+    trace_out = obs.maybe_enable_from_env()   # UT_TRACE=<path>
     with guard_from_env() as guard:
         from uptune_tpu.driver import Tuner
         from uptune_tpu.workloads import rosenbrock_space
@@ -205,11 +443,16 @@ def driver_main() -> None:
                     done += 1
             return done
 
-        warm = drain(200)     # compile every arm + commit + observe
+        # bench phases land on the obs timeline (spans are no-ops
+        # unless UT_TRACE enabled tracing above)
+        with obs.span("bench.warm"):
+            warm = drain(200)  # compile every arm + commit + observe
         steady = 500 if quick else 2000
-        t0 = time.perf_counter()
-        steady = drain(steady)
-        dt = time.perf_counter() - t0
+        with obs.span("bench.steady", trials=steady):
+            t0 = time.perf_counter()
+            steady = drain(steady)
+            dt = time.perf_counter() - t0
+    obs.finish(trace_out)
     rate = steady / dt
     res = tuner.result()
     result = {
@@ -333,15 +576,18 @@ def cache_main() -> None:
         res = pt.run()
         return pt, res, time.perf_counter() - t0, clock
 
+    from uptune_tpu import obs
+    trace_out = obs.maybe_enable_from_env()
     try:
         # one guard per run: each run builds its own Tuner (fresh jit
         # wrappers from the same code objects), which across ONE guard
         # would read as wrapper churn; per-run guards prove what the
         # CLI contract promises — one tune compiles each program once
-        with guard_from_env() as guard1:
+        with guard_from_env() as guard1, obs.span("bench.run1_build"):
             pt1, res1, wall1, _ = tune()
-        with guard_from_env() as guard2:
+        with guard_from_env() as guard2, obs.span("bench.run2_serve"):
             pt2, res2, wall2, clock2 = tune()
+        obs.finish(trace_out)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
 
@@ -555,12 +801,16 @@ def surrogate_main() -> None:
     # multi-second XLA compiles) — the same philosophy as the driver
     # bench's 200 warm trials.  Tracing still happens live in the
     # guarded runs, so the strict retrace report keeps its teeth.
-    lat_run(False)
+    from uptune_tpu import obs
+    trace_out = obs.maybe_enable_from_env()
+    with obs.span("bench.warmup"):
+        lat_run(False)
 
-    with guard_from_env() as guard_sync:
+    with guard_from_env() as guard_sync, obs.span("bench.sync"):
         sync = lat_run(False)
-    with guard_from_env() as guard_async:
+    with guard_from_env() as guard_async, obs.span("bench.async"):
         asyn = lat_run(True)
+    obs.finish(trace_out)
 
     # protocol B: iterations-to-optimum spot check (BENCHREPORT
     # thresholds: 2d <= 0.1 within 2000, 4d <= 1.0 within 4000)
@@ -696,7 +946,9 @@ def multi_main() -> None:
     if platform == "cpu:fallback":
         quick = True
 
+    from uptune_tpu import obs
     from uptune_tpu.analysis.trace_guard import guard_from_env
+    trace_out = obs.maybe_enable_from_env()
     with guard_from_env() as guard:
         from uptune_tpu.engine import (BatchedEngine, FusedEngine,
                                        default_arms, make_instance_mesh)
@@ -735,15 +987,16 @@ def multi_main() -> None:
 
         reps = 3
         rep_times = []
-        for r in range(reps):
-            # identical reps measure wall time, not search quality
-            # ut-lint: disable-next=R002
-            s = be.init(jax.random.PRNGKey(1))
-            jax.block_until_ready(s)
-            t0 = time.perf_counter()
-            s = compiled(s)
-            jax.block_until_ready(s)
-            rep_times.append(time.perf_counter() - t0)
+        with obs.span("bench.batched_reps", reps=reps):
+            for r in range(reps):
+                # identical reps measure wall time, not search quality
+                # ut-lint: disable-next=R002
+                s = be.init(jax.random.PRNGKey(1))
+                jax.block_until_ready(s)
+                t0 = time.perf_counter()
+                s = compiled(s)
+                jax.block_until_ready(s)
+                rep_times.append(time.perf_counter() - t0)
         best_t = min(rep_times)
 
         # N-sequential baseline: one instance, same shapes, same
@@ -823,6 +1076,7 @@ def multi_main() -> None:
             exch_rate = steps * n_inst * eng.total_batch / (
                 time.perf_counter() - t0)
 
+    obs.finish(trace_out)
     acqs = steps * n_inst * eng.total_batch
     rate = acqs / best_t
     rate_chip = rate / n_dev
@@ -918,6 +1172,9 @@ def multi_main() -> None:
 
 
 def main() -> None:
+    if "--obs" in sys.argv:
+        obs_main()
+        return
     if "--driver" in sys.argv:
         driver_main()
         return

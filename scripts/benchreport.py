@@ -605,8 +605,53 @@ def to_markdown(rows, seeds):
         lines += ["", GCC_REAL_ANALYSIS, "", SCREENING_NOTE]
     if any(r["mode"] == "surrogate-bandit" for r in rows):
         lines += ["", BANDIT_ARBITRATION_NOTE]
+    pool_note = pool_utilization_note()
+    if pool_note:
+        lines += ["", pool_note]
     lines += ["", AB_PORTFOLIO_NOTE]
     lines.append("")
+    return "\n".join(lines)
+
+
+def pool_utilization_note():
+    """WorkerPool.stats() surfaced in the report (ISSUE 7 satellite):
+    the evaluation pool computes launched / dead-worker replacements /
+    busy slot-seconds / utilization for every program-mode run, and the
+    bench artifacts embed them — but no report ever showed them, so
+    the async pipeline's scoreboard (how full the build slots actually
+    ran) stayed invisible.  Reads the committed BENCH_CACHE.json
+    runs; '' when the artifact is absent (e.g. a fresh checkout)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_CACHE.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return ""
+    lines = [
+        "## Evaluation-plane utilization (WorkerPool.stats())",
+        "",
+        "Slot-seconds the subprocess build pool spent running trials,",
+        "from the committed BENCH_CACHE.json protocol (run 1 builds +",
+        "populates the store; run 2 replays and serves from it — its",
+        "pool sits idle BY DESIGN, that is the build elimination).",
+        "utilization = busy_s / (wall x slots); the gap to 1.0 in a",
+        "build run is dispatch overhead prefetch failed to hide.",
+        "Per-run live numbers: the `[ut] pool utilization=` line, or",
+        "`ut --trace out.json` for per-slot build lanes",
+        "(docs/OBSERVABILITY.md).",
+        "",
+        "| run | launched | replaced | busy_s | utilization |",
+        "|---|---|---|---|---|",
+    ]
+    for run in ("run1", "run2"):
+        p = doc.get(run, {}).get("pool")
+        if not p:
+            return ""
+        lines.append(
+            f"| {run} ({'build' if run == 'run1' else 'serve'}) "
+            f"| {p['launched']} | {p['replaced']} | {p['busy_s']} "
+            f"| {p['utilization']} |")
     return "\n".join(lines)
 
 

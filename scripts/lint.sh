@@ -24,17 +24,20 @@ if [ -f scripts/lint_baseline.json ]; then
 fi
 "${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"
 
-# uptune_tpu/store/, uptune_tpu/surrogate/, uptune_tpu/engine/ and
-# uptune_tpu/ops/ must stay SUPPRESSION-FREE on top of clean:
-# cache-correctness code (what decides whether a build is skipped,
-# ISSUE 4), the concurrent background-refit plane (ISSUE 5), and the
-# fused/batched engine + Pallas kernels every perf headline rests on
-# (ISSUE 6) get no '# ut-lint: disable' escape hatch and no baseline
+# uptune_tpu/store/, uptune_tpu/surrogate/, uptune_tpu/engine/,
+# uptune_tpu/ops/ and uptune_tpu/obs/ must stay SUPPRESSION-FREE on
+# top of clean: cache-correctness code (what decides whether a build
+# is skipped, ISSUE 4), the concurrent background-refit plane
+# (ISSUE 5), the fused/batched engine + Pallas kernels every perf
+# headline rests on (ISSUE 6), and the observability plane whose
+# instrumentation lives INSIDE every hot path (ISSUE 7 — a silenced
+# hazard there would tax or skew the very measurements it exists to
+# make) get no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys
 rc = 0
 for pkg in ("uptune_tpu/store", "uptune_tpu/surrogate",
-            "uptune_tpu/engine", "uptune_tpu/ops"):
+            "uptune_tpu/engine", "uptune_tpu/ops", "uptune_tpu/obs"):
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis", pkg,
          "--format", "json", "--show-suppressed"],

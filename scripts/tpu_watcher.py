@@ -8,10 +8,14 @@ every few minutes and, the moment the backend initializes, runs the full
 (non-quick) `bench.py`, which writes the BENCH_TPU.json evidence artifact
 (per-rep wall times, device repr, XLA flops/bytes, roofline util).
 
-Every attempt is logged with a timestamp to the log file (stdout), so if
-the tunnel never opens all round the committed log is the proof.
+Every attempt is logged with a timestamp — to stdout AND to the log
+file the script itself writes under exp_archives/ (run artifacts live
+there, not at the repo root — ISSUE 7 hygiene; override with
+UT_WATCHER_LOG) — so if the tunnel never opens all round the on-disk
+log is the proof without any shell redirection.
 
-Usage:  nohup python scripts/tpu_watcher.py > tpu_watcher.log 2>&1 &
+Usage:  nohup python scripts/tpu_watcher.py >/dev/null 2>&1 &
+        tail -f exp_archives/tpu_watcher.log
 """
 import os
 import subprocess
@@ -23,12 +27,24 @@ TOTAL_BUDGET_S = float(os.environ.get("UT_WATCHER_BUDGET_S", 11.0 * 3600))
 PROBE_TIMEOUT_S = 120.0
 SLEEP_S = 180.0
 
+LOG_PATH = os.environ.get(
+    "UT_WATCHER_LOG", os.path.join(REPO, "exp_archives",
+                                   "tpu_watcher.log"))
+
 PROBE_CODE = ("import jax; d = jax.devices()[0]; "
               "print('UT_PLATFORM=' + d.platform)")
 
+_log_f = None
+
 
 def log(msg: str) -> None:
-    print(f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {msg}", flush=True)
+    global _log_f
+    line = f"[{time.strftime('%Y-%m-%d %H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    if _log_f is None:
+        os.makedirs(os.path.dirname(LOG_PATH), exist_ok=True)
+        _log_f = open(LOG_PATH, "a", buffering=1)
+    _log_f.write(line + "\n")
 
 
 def probe() -> str:

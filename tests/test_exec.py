@@ -159,7 +159,12 @@ class TestProgramTuner:
         # the survivors still tuned toward x=75
         assert res.best_qor <= abs(80 - 75)  # at least the default
 
+    @pytest.mark.slow
     def test_rules_restrict_search_space(self, tmp_path):
+        """Slow-marked (ISSUE 7 suite-budget reclaim: ~12s of
+        subprocess builds); the driver-level filter mechanics keep the
+        fast in-process sibling below, and the registry logic stays
+        tier-1 in test_api::test_rules_and_constraints_enforced."""
         @uptune_tpu.rule()
         def x_small(cfg):
             return cfg["x"] <= 20
@@ -171,6 +176,24 @@ class TestProgramTuner:
         evaluated = [r for r in rows if r["tech"] != "seed"]
         assert evaluated and all(r["cfg"]["x"] <= 20 for r in evaluated)
         assert pt.tuner.filtered_total > 0
+
+    def test_config_filter_restricts_library_tuner(self):
+        """Fast sibling of the e2e rule test above: the SAME
+        config_filter path (_open_ticket drops rejected rows before
+        they become trials; filtered_total counts them) on an
+        in-process Tuner — no subprocesses."""
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.exec.space_io import space_from_params
+        space = space_from_params(
+            [{"name": "x", "type": "int", "default": 50,
+              "lo": 0, "hi": 100}])
+        t = Tuner(space, lambda cfgs: [abs(c["x"] - 10.0)
+                                       for c in cfgs],
+                  seed=5, config_filter=lambda c: c["x"] <= 20)
+        res = t.run(test_limit=30)
+        assert t.filtered_total > 0
+        assert res.evals > 0
+        assert res.best_config["x"] <= 20
 
     def test_constraint_marks_violations_failed(self, tmp_path):
         @uptune_tpu.constraint()

@@ -620,7 +620,7 @@ class TestTraceGuard:
 
 # ------------------------------------------------------- repo gate
 @pytest.mark.parametrize("package", ["store", "surrogate", "engine",
-                                     "ops"])
+                                     "ops", "obs"])
 def test_package_suppression_free(package):
     """Packages on the correctness-critical fast path must be finding-
     AND suppression-free: no '# ut-lint: disable' escape hatch, no
@@ -630,8 +630,11 @@ def test_package_suppression_free(package):
     there would hide a stall on the very path this PR moved off the
     driver; engine/ and ops/ carry the fused/batched acquisition loop
     and its Pallas kernels (ISSUE 6) — a silenced hazard there would
-    invalidate every BENCH_* headline measured through them.  lint.sh
-    enforces the same in the pre-commit gate."""
+    invalidate every BENCH_* headline measured through them; obs/ is
+    instrumentation living INSIDE every hot path (ISSUE 7) — a
+    silenced hazard there would tax or skew the measurements it
+    exists to make.  lint.sh enforces the same in the pre-commit
+    gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",
          os.path.join(REPO, "uptune_tpu", package),

@@ -1,0 +1,341 @@
+"""Unified observability plane (uptune_tpu/obs/, docs/OBSERVABILITY.md):
+ring-buffer correctness under concurrent writers, the disabled-path
+zero-event guarantee, Chrome trace-event schema round-trip, the
+committed example artifact, and the ISSUE 7 structural acceptance
+criteria — background refit spans OVERLAP driver dispatch spans, and
+store-hit tickets BYPASS the worker build lanes (asserted on recorded
+events, not by eyeball)."""
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+import uptune_tpu
+from uptune_tpu import obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+ENV = {"PYTHONPATH": REPO}
+
+
+@pytest.fixture(autouse=True)
+def obs_clean():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ------------------------------------------------------------- core
+class TestCore:
+    def test_disabled_path_records_nothing(self):
+        """The zero-event assertion: with tracing off, every API entry
+        is inert — and span() hands back ONE shared singleton (no
+        allocation on the hot path)."""
+        assert not obs.enabled()
+        s1 = obs.span("a", k=1)
+        s2 = obs.device_span("b")
+        assert s1 is s2, "disabled span must be the shared no-op"
+        with s1:
+            pass
+        obs.event("e", x=2)
+        obs.complete_span("c", t0=0.0, dur=1.0, track="worker-0")
+        obs.count("n")
+        obs.gauge("g", 3)
+        obs.observe("h", 4.0)
+        snap = obs.snapshot()
+        assert snap["events"] == []
+        m = obs.metrics_snapshot()
+        assert m["counters"] == {} and m["gauges"] == {} \
+            and m["hists"] == {}
+
+    def test_span_event_metrics_roundtrip(self):
+        obs.enable()
+        with obs.span("ticket.propose", arm="de") as sp:
+            sp.set(rows=3)
+        obs.event("ticket.open", gid=7)
+        obs.complete_span("pool.build", t0=time.perf_counter(),
+                          dur=0.5, track="worker-1", gid=7)
+        obs.count("store.hits", 2)
+        obs.gauge("prefetch.depth", 5)
+        obs.observe("store.serve_ms", 0.7)
+        evs = obs.snapshot()["events"]
+        by = {e["name"]: e for e in evs}
+        assert by["ticket.propose"]["dur"] >= 0
+        assert by["ticket.propose"]["attrs"] == {"arm": "de", "rows": 3}
+        assert by["ticket.open"]["dur"] is None
+        assert by["pool.build"]["track"] == "worker-1"
+        m = obs.metrics_snapshot()
+        assert m["counters"]["store.hits"] == 2
+        assert m["gauges"]["prefetch.depth"] == 5
+        assert m["hists"]["store.serve_ms"]["count"] == 1
+
+    def test_ring_wraps_and_counts_drops(self):
+        obs.enable(capacity=8)
+        for i in range(20):
+            obs.event("e", i=i)
+        snap = obs.snapshot()
+        assert len(snap["events"]) == 8
+        # oldest overwritten: only the last 8 survive, in order
+        assert [e["attrs"]["i"] for e in snap["events"]] == \
+            list(range(12, 20))
+        assert sum(snap["dropped"].values()) == 12
+
+    def test_concurrent_writers_lose_nothing(self):
+        """Driver + refit-thread + pool shape: N threads record into
+        their own rings concurrently; every event survives intact, in
+        per-thread order, with no cross-thread interleaving damage."""
+        obs.enable(capacity=4096)
+        n_threads, per = 4, 1000
+        start = threading.Barrier(n_threads + 1)
+
+        def writer(tid):
+            start.wait()
+            for i in range(per):
+                obs.event("w", tid=tid, i=i)
+
+        ts = [threading.Thread(target=writer, args=(k,),
+                               name=f"obs-writer-{k}")
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        start.wait()
+        for i in range(per):
+            obs.event("w", tid=-1, i=i)
+        for t in ts:
+            t.join()
+        snap = obs.snapshot()
+        assert sum(snap["dropped"].values()) == 0
+        seen = {}
+        for e in snap["events"]:
+            a = e["attrs"]
+            seen.setdefault(a["tid"], []).append(a["i"])
+        assert set(seen) == {-1, 0, 1, 2, 3}
+        for tid, idxs in seen.items():
+            assert idxs == list(range(per)), \
+                f"thread {tid} lost or reordered events"
+        # per-thread timestamps are monotonic (each ring is
+        # single-writer, so order == record order)
+        by_track = {}
+        for e in snap["events"]:
+            by_track.setdefault(e["track"], []).append(e["ts"])
+        for track, tss in by_track.items():
+            assert tss == sorted(tss), f"{track} timestamps regressed"
+
+    def test_enable_cycle_isolates_runs(self):
+        """A thread surviving an enable() cycle (the refit worker
+        shape) must re-register: its old ring is never exported, its
+        new records are."""
+        obs.enable()
+        done1 = threading.Event()
+        go2 = threading.Event()
+        done2 = threading.Event()
+
+        def worker():
+            obs.event("old", run=1)
+            done1.set()
+            go2.wait(5)
+            obs.event("new", run=2)
+            done2.set()
+
+        t = threading.Thread(target=worker, name="survivor")
+        t.start()
+        done1.wait(5)
+        obs.enable()        # second run: clears rings, bumps epoch
+        go2.set()
+        done2.wait(5)
+        t.join(5)
+        evs = obs.snapshot()["events"]
+        assert [e["name"] for e in evs] == ["new"]
+
+
+# ------------------------------------------------------------ export
+class TestExport:
+    def _populate(self):
+        obs.enable()
+        with obs.span("ticket.propose", arm="de"):
+            pass
+        obs.event("ticket.finalize", step=1)
+        obs.complete_span("pool.build", t0=time.perf_counter(),
+                          dur=0.25, track="worker-0", gid=3)
+
+        def bg():
+            with obs.span("surrogate.fit", background=True):
+                pass
+
+        t = threading.Thread(target=bg, name="ut-surrogate-refit_0")
+        t.start()
+        t.join()
+        obs.count("store.hits")
+        obs.observe("store.serve_ms", 0.8)
+
+    def test_trace_schema_roundtrip(self, tmp_path):
+        self._populate()
+        path = str(tmp_path / "trace.json")
+        obs.write_trace(path, extra={"note": "test"})
+        obs.write_metrics_jsonl(path + ".metrics.jsonl")
+        with open(path) as f:
+            doc = json.load(f)          # the round trip
+        obs.validate_trace(doc)
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"MainThread", "worker-0",
+                "ut-surrogate-refit_0"} <= lanes
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        build = next(e for e in xs if e["name"] == "pool.build")
+        assert abs(build["dur"] - 250_000) < 1_000   # µs
+        assert doc["otherData"]["note"] == "test"
+        assert doc["otherData"]["metrics"]["counters"][
+            "store.hits"] == 1
+        row = json.loads(
+            open(path + ".metrics.jsonl").readline())
+        assert row["counters"]["store.hits"] == 1
+        assert row["hists"]["store.serve_ms"]["count"] == 1
+
+    def test_validate_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            obs.validate_trace({"events": []})
+        with pytest.raises(ValueError):
+            obs.validate_trace({"traceEvents": [
+                {"ph": "X", "pid": 1, "tid": 1, "name": "a",
+                 "ts": 0.0}]})        # X without dur
+        with pytest.raises(ValueError):
+            obs.validate_trace({"traceEvents": [
+                {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+                 "args": {"name": "t"}},
+                {"ph": "i", "pid": 1, "tid": 2, "name": "a",
+                 "ts": 0.0, "s": "t"}]})   # tid 2 unnamed
+
+    def test_committed_example_trace_validates(self):
+        """The checked-in Perfetto artifact (bench.py --obs phase 3)
+        must satisfy the schema contract and actually show the async
+        shape: a refit-worker lane distinct from the driver lane, with
+        fit spans on it."""
+        path = os.path.join(REPO, "exp_archives",
+                            "obs_trace_example.json")
+        with open(path) as f:
+            doc = json.load(f)
+        obs.validate_trace(doc)
+        name_of = {e["tid"]: e["args"]["name"]
+                   for e in doc["traceEvents"]
+                   if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert len(set(name_of.values())) >= 2
+        fit_lanes = {name_of[e["tid"]] for e in doc["traceEvents"]
+                     if e["ph"] == "X" and e["name"] == "surrogate.fit"}
+        assert any(l != "MainThread" for l in fit_lanes)
+
+    def test_text_summary_mentions_spans_and_drops(self):
+        self._populate()
+        s = obs.text_summary()
+        assert "pool.build" in s and "store.hits" in s
+
+
+# -------------------------------------------------- structural gates
+def _overlaps(a, b):
+    return a["ts"] < b["ts"] + b["dur"] and b["ts"] < a["ts"] + a["dur"]
+
+
+class TestStructural:
+    def test_refit_spans_overlap_dispatch(self):
+        """ISSUE 7 acceptance: a traced async tune must SHOW the
+        overlap the async surrogate plane claims — a background
+        surrogate.fit span on the refit-worker lane intersecting
+        driver-lane ticket spans in time."""
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.workloads import (rosenbrock_objective,
+                                          rosenbrock_space)
+        obs.enable()
+        obj = rosenbrock_objective(2)
+        tuner = Tuner(rosenbrock_space(2, -2.048, 2.048), None, seed=0,
+                      surrogate="gp",
+                      surrogate_opts={"min_points": 8,
+                                      "refit_interval": 8,
+                                      "max_points": 64,
+                                      "async_refit": True})
+        done = 0
+        while done < 48:
+            for tr in tuner.ask(min_trials=1):
+                tuner.tell(tr, float(obj([tr.config])[0]))
+                done += 1
+        tuner.close()   # drains the background worker
+        evs = obs.snapshot()["events"]
+        fits = [e for e in evs if e["name"] == "surrogate.fit"
+                and (e["attrs"] or {}).get("background")]
+        assert fits, "no background fit ran — protocol broken"
+        assert all(e["track"] != "MainThread" for e in fits)
+        driver = [e for e in evs
+                  if e["track"] == "MainThread"
+                  and e["dur"] is not None
+                  and e["name"].startswith("ticket.")]
+        assert driver
+        assert any(_overlaps(f, d) for f in fits for d in driver), \
+            "refit never overlapped driver dispatch — the async " \
+            "plane's whole claim"
+
+    def test_store_hits_bypass_build_lane(self, tmp_path):
+        """ISSUE 7 acceptance: store-hit tickets must never appear on
+        a worker build lane.  Run 1 populates the store (untraced);
+        run 2 (traced, larger budget) serves the replayed prefix from
+        the store and builds only novel configs — the recorded events
+        prove the bypass: serve gids and build gids are disjoint,
+        both non-empty."""
+        from uptune_tpu.exec.controller import ProgramTuner
+        prog = tmp_path / "prog.py"
+        prog.write_text(textwrap.dedent("""
+            import uptune_tpu as ut
+            x = ut.tune(50, (0, 100), name="x")
+            y = ut.tune(50, (0, 100), name="y")
+            ut.target(float((x - 37) ** 2 + (y - 11) ** 2), "min")
+        """))
+
+        def mk(limit):
+            return ProgramTuner([sys.executable, str(prog)],
+                                str(tmp_path), parallel=1, prefetch=0,
+                                test_limit=limit, seed=0, env=ENV,
+                                runtime_limit=30.0)
+
+        mk(5).run()
+        obs.enable()
+        pt2 = mk(10)
+        pt2.run()
+        assert pt2.store_hits > 0
+        assert pt2.pool.launched > 0
+        evs = obs.snapshot()["events"]
+        served = {(e["attrs"] or {}).get("gid") for e in evs
+                  if e["name"] == "store.serve_hit"}
+        built = {(e["attrs"] or {}).get("gid") for e in evs
+                 if e["name"] == "pool.build"}
+        assert served and built
+        assert all(e["track"] == "store" for e in evs
+                   if e["name"] == "store.serve_hit")
+        assert not (served & built), \
+            f"gids {served & built} were served AND built"
+        assert len(served) == pt2.store_hits
+        assert len(built) == pt2.pool.launched
+
+
+class TestGuardMerge:
+    def test_retrace_events_land_on_timeline(self):
+        """The TraceGuard report is part of the obs export now: every
+        jit trace inside a guard is an instant event, and excess ones
+        are flagged on the event itself."""
+        import jax
+        import jax.numpy as jnp
+
+        from uptune_tpu.analysis.trace_guard import TraceGuard
+        obs.enable()
+        with TraceGuard(limit=1, name="t"):
+            @jax.jit
+            def f(x):
+                return x + 1
+
+            f(jnp.ones(2))
+            f(jnp.ones(3))   # retrace (new shape) -> excess
+        evs = [e for e in obs.snapshot()["events"]
+               if e["name"] == "jit.trace"]
+        assert len(evs) == 2
+        assert [e["attrs"]["excess"] for e in evs] == [False, True]
+        assert obs.metrics_snapshot()["counters"]["jit.traces"] == 2

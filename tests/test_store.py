@@ -488,17 +488,31 @@ class TestEndToEndGates:
 
     def test_full_ut_run_strict_trace_guard_with_store(self, tmp_path):
         """Acceptance gate: a full `ut` CLI tune with the store enabled
-        (default) passes UT_TRACE_GUARD=strict — the serve path adds no
-        retraces."""
+        (default) AND span tracing on (`--trace`, ISSUE 7) passes
+        UT_TRACE_GUARD=strict — neither the serve path nor the
+        observability plane adds retraces, and the exported trace
+        validates against the schema with the guard report merged into
+        it (no separate stderr report when traced)."""
         prog = tmp_path / "prog.py"
         prog.write_text(QUAD)
+        trace = tmp_path / "out_trace.json"
         env = {**os.environ, **ENV, "UT_TRACE_GUARD": "strict"}
         r = subprocess.run(
             [sys.executable, "-m", "uptune_tpu.cli", str(prog),
-             "--test-limit", "6", "-pf", "2"],
+             "--test-limit", "6", "-pf", "2", "--trace", str(trace)],
             capture_output=True, text=True, env=env, cwd=str(tmp_path),
             timeout=420)
         assert r.returncode == 0, r.stdout + r.stderr
         out = json.loads(r.stdout.strip().splitlines()[-1])
         assert out["evals"] >= 6
         assert (tmp_path / "ut.temp" / "store").is_dir()
+        from uptune_tpu import obs
+        with open(trace) as f:
+            doc = json.load(f)
+        obs.validate_trace(doc)
+        # the retrace report ships inside the export when tracing
+        assert doc["otherData"]["trace_guard"]["excess"] == {}
+        lanes = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert any(l.startswith("worker-") for l in lanes)
+        assert (tmp_path / "out_trace.json.metrics.jsonl").is_file()

@@ -63,6 +63,16 @@ class TraceGuard:
     def record(self, label: str) -> None:
         with _lock:
             self.counts[label] = self.counts.get(label, 0) + 1
+            n = self.counts[label]
+        # every trace is also an instant on the obs timeline (no-op
+        # when tracing is off), so retrace churn shows up IN the
+        # exported Perfetto trace next to the spans it stalls instead
+        # of only in a separate end-of-run report; excess=True marks
+        # the ones over budget
+        from .. import obs
+        obs.event("jit.trace", fn=label, n=n,
+                  excess=n > self.limit)
+        obs.count("jit.traces")
 
     def excess(self) -> Dict[str, int]:
         """{function label: count} for functions over the limit —

@@ -53,6 +53,13 @@ DEFAULTS: Dict[str, Any] = {
     # same (space, program): preload best-so-far + dedup history +
     # surrogate training set before the first acquisition
     "warm-start": False,
+    # observability plane (docs/OBSERVABILITY.md): a path turns on
+    # cross-plane span tracing for the run and writes a
+    # Perfetto-viewable Chrome trace there (+ a metrics-snapshot JSONL
+    # next to it); None/'off' leaves tracing disabled (the
+    # instrumented hot paths cost one flag check).  Layered under the
+    # `ut --trace` flag and the UT_TRACE env var
+    "trace": None,
     # async surrogate plane (docs/PERF.md): 'on' (None = default) moves
     # the O(N^3) GP refit + fit_auto hyperparameter sweep onto a
     # background worker publishing versioned snapshots, so the driver

@@ -175,6 +175,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "wired, so library-mode programs can call "
                         "uptune_tpu.parallel.initialize() for the "
                         "jax.distributed sharded-engine plane")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="unified observability plane "
+                        "(docs/OBSERVABILITY.md): record cross-plane "
+                        "spans (ticket lifecycle, worker-slot build "
+                        "lanes, background refit, store hits) and "
+                        "write a Perfetto-viewable Chrome trace JSON "
+                        "here, plus OUT.json.metrics.jsonl with the "
+                        "run's counters/gauges/histograms.  Also "
+                        "reachable via UT_TRACE=<path> or "
+                        "ut.config({'trace': ...}); 'off' disables")
     p.add_argument("--device", choices=("cpu", "accel"), default="cpu",
                    help="platform for the search engine (default cpu: "
                         "black-box evals dominate; 'accel' trusts the "
@@ -471,6 +481,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{math.log10(size) if size else 0:.2f}")
         return 0
 
+    # observability plane (docs/OBSERVABILITY.md): flag > UT_TRACE env
+    # > ut.config('trace').  Enabled BEFORE the tune so analysis, warm
+    # start, and every ticket land on the timeline; exported after.
+    from . import obs
+    trace_path = args.trace
+    if trace_path is None:
+        trace_path = obs.maybe_enable_from_env()
+        if trace_path is None and not obs.enabled():
+            cfg_trace = settings["trace"]
+            if cfg_trace and str(cfg_trace).lower() not in ("off",
+                                                            "none"):
+                trace_path = str(cfg_trace)
+    elif trace_path.lower() in ("off", "none"):
+        trace_path = None
+    pid_env = os.environ.get("UT_PROCESS_ID")
+    if trace_path and pid_env and pid_env != "0":
+        # --num-hosts replicas each trace their own file (same rule as
+        # ut.archive.hN.jsonl: N appenders never share one path)
+        root, ext = os.path.splitext(trace_path)
+        trace_path = f"{root}.h{pid_env}{ext}"
+    if trace_path and not obs.enabled():
+        obs.enable()
+
     from .analysis.trace_guard import guard_from_env
     from .exec.multistage import run_auto
     # UT_TRACE_GUARD=1|strict: count per-function jit traces over the
@@ -478,7 +511,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     # per technique, not once per step
     with guard_from_env() as guard:
         res = run_auto(pt)   # single / multi-stage / decouple dispatch
-    if guard.enabled:
+    if obs.enabled():
+        # the trace-guard retrace report ships INSIDE the obs export
+        # (and every individual trace is already an instant event on
+        # the timeline) instead of as a separate stderr report
+        extra = ({"trace_guard": guard.report()} if guard.enabled
+                 else None)
+        if trace_path:
+            obs.finish(trace_path, extra=extra)
+            log.info("[ut] trace written to %s (open in "
+                     "https://ui.perfetto.dev; metrics in %s)",
+                     trace_path, trace_path + ".metrics.jsonl")
+        elif guard.enabled:
+            # recording without an output path (UT_TRACE=1): there is
+            # no trace document for the report to ride in, so keep the
+            # stderr line
+            log.info("[ut] trace-guard: %s", json.dumps(guard.report()))
+        for line in obs.text_summary().splitlines():
+            log.info("[ut] %s", line)
+    elif guard.enabled:
         log.info("[ut] trace-guard: %s", json.dumps(guard.report()))
     log.info("[ut] done: best qor=%.6g evals=%d", res.best_qor, res.evals)
     print(json.dumps({"best_config": res.best_config,

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..space.spec import CandBatch, Space, pad_cands
 from ..techniques import base as tbase
 from ..techniques.base import Best, Technique
@@ -802,8 +803,10 @@ class Tuner:
             self.key, k = jax.random.split(self.key)
             # ONE fused device program: propose + pad + hash + dedup
             p0 = time.perf_counter()
-            tstate, cands, hashes, known, src, novel = self._propose_jit[
-                t.name](self._tstates[t.name], k, self.best,
+            with obs.device_span("ticket.propose", arm=t.name):
+                tstate, cands, hashes, known, src, novel = \
+                    self._propose_jit[t.name](
+                        self._tstates[t.name], k, self.best,
                         self.hist_state)
             t_prop += time.perf_counter() - p0
             if t.name not in self._fwd_checked:
@@ -839,9 +842,10 @@ class Tuner:
                 injected = True
                 self.key, k = jax.random.split(self.key)
                 p0 = time.perf_counter()
-                cands = self.space.random(k, cands.batch)
-                hashes, found, known, src, novel = self._dedup(
-                    self.hist_state, cands)
+                with obs.device_span("ticket.propose", arm="random"):
+                    cands = self.space.random(k, cands.batch)
+                    hashes, found, known, src, novel = self._dedup(
+                        self.hist_state, cands)
                 t_prop += time.perf_counter() - p0
                 novel_np, n_novel, packed = self._mask_pending(hashes,
                                                                novel)
@@ -876,35 +880,50 @@ class Tuner:
         """Materialize trials for a ticket's novel rows (after the
         optional ut.rule config filter) and register them pending."""
         tk.t_open = time.perf_counter()
-        if tk.packed is None:  # all acquisition paths pre-pack
-            tk.packed = self._pack_hashes(tk.hashes)
-        if tk.novel_np.any():
-            idx = np.nonzero(tk.novel_np)[0]
-            # one device->host transfer of the whole batch, then plain
-            # numpy row selection: the old per-ticket device gather was
-            # two extra dispatches on the ask() critical path
-            u_all = np.asarray(tk.cands.u)
-            perms_all = [np.asarray(p) for p in tk.cands.perms]
-            sub = CandBatch(u_all[idx], tuple(p[idx] for p in perms_all))
-            cfgs = self.space.to_configs(sub)
-            if self.config_filter is not None:
-                keep = np.asarray([bool(self.config_filter(c))
-                                   for c in cfgs])
-                if not keep.all():
-                    self.filtered_total += int((~keep).sum())
-                    tk.novel_np[idx[~keep]] = False
-                    idx = idx[keep]
-                    cfgs = [c for c, k in zip(cfgs, keep) if k]
-                    sub = CandBatch(u_all[idx],
-                                    tuple(p[idx] for p in perms_all))
-            if len(idx):
-                tk.u_np = np.asarray(sub.u)
-                tk.perms_np = [np.asarray(p) for p in sub.perms]
-                for j, (row, cfg) in enumerate(zip(idx, cfgs)):
-                    tk.trials.append(Trial(self.gid, cfg, tk, j, int(row)))
-                    self.gid += 1
-                    self._pending.add(int(tk.packed[row]))
-        tk.remaining = len(tk.trials)
+        sp_obs = obs.span("ticket.dedup", arm=tk.arm_name)
+        sp_obs.__enter__()
+        try:
+            if tk.packed is None:  # all acquisition paths pre-pack
+                tk.packed = self._pack_hashes(tk.hashes)
+            if tk.novel_np.any():
+                idx = np.nonzero(tk.novel_np)[0]
+                # one device->host transfer of the whole batch, then
+                # plain numpy row selection: the old per-ticket device
+                # gather was two extra dispatches on the ask() critical
+                # path
+                u_all = np.asarray(tk.cands.u)
+                perms_all = [np.asarray(p) for p in tk.cands.perms]
+                sub = CandBatch(u_all[idx],
+                                tuple(p[idx] for p in perms_all))
+                cfgs = self.space.to_configs(sub)
+                if self.config_filter is not None:
+                    keep = np.asarray([bool(self.config_filter(c))
+                                       for c in cfgs])
+                    if not keep.all():
+                        self.filtered_total += int((~keep).sum())
+                        tk.novel_np[idx[~keep]] = False
+                        idx = idx[keep]
+                        cfgs = [c for c, k in zip(cfgs, keep) if k]
+                        sub = CandBatch(u_all[idx],
+                                        tuple(p[idx] for p in perms_all))
+                if len(idx):
+                    tk.u_np = np.asarray(sub.u)
+                    tk.perms_np = [np.asarray(p) for p in sub.perms]
+                    for j, (row, cfg) in enumerate(zip(idx, cfgs)):
+                        tk.trials.append(
+                            Trial(self.gid, cfg, tk, j, int(row)))
+                        self.gid += 1
+                        self._pending.add(int(tk.packed[row]))
+            tk.remaining = len(tk.trials)
+            sp_obs.set(trials=len(tk.trials),
+                       gid0=(tk.trials[0].gid if tk.trials else None))
+        finally:
+            # a raising user config_filter must not lose the span —
+            # the half-open ticket is exactly what a trace debugger
+            # needs to see
+            sp_obs.__exit__(None, None, None)
+        if tk.trials:
+            obs.count("driver.trials_opened", len(tk.trials))
         st = self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])
         st[0] += 1
         st[1] += len(tk.trials)
@@ -941,6 +960,7 @@ class Tuner:
         """Propose >= min_trials hash-novel trials for external
         evaluation (fewer only if the space saturates)."""
         trials: List[Trial] = []
+        obs.count("driver.asks")
         for _ in range(max_attempts):
             tk = self._acquire()
             if tk.trials:
@@ -964,6 +984,7 @@ class Tuner:
         trial.qor = self.sign * v if math.isfinite(v) else float("inf")
         trial.dur = dur
         self.told += 1
+        obs.count("driver.told")
         if self.hooks:
             _fire(self.hooks, "on_result", self, trial,
                   float(qor) if math.isfinite(v) else None)
@@ -980,6 +1001,9 @@ class Tuner:
         if trial.qor is not None or trial.cancelled:
             raise ValueError(f"trial gid={trial.gid} already resolved")
         trial.cancelled = True
+        obs.event("ticket.withdraw", gid=trial.gid,
+                  arm=trial.ticket.arm_name)
+        obs.count("driver.withdrawn")
         tk = trial.ticket
         tk.remaining -= 1
         if tk.remaining == 0:
@@ -1027,9 +1051,10 @@ class Tuner:
         if evaluated or tk.novel_np.any():
             # in-batch duplicates copy their source row's result
             qor = jnp.asarray(qor_np[tk.src])
-            self.hist_state, self.best = self._commit(
-                self.hist_state, self.best, tk.hashes, tk.cands, qor,
-                jnp.asarray(tk.novel_np))
+            with obs.device_span("ticket.commit", arm=tk.arm_name):
+                self.hist_state, self.best = self._commit(
+                    self.hist_state, self.best, tk.hashes, tk.cands,
+                    qor, jnp.asarray(tk.novel_np))
             self._last_dropped = int(self.hist_state.dropped)
             new = float(self.best.qor)
         else:
@@ -1067,8 +1092,9 @@ class Tuner:
                         self._observe_nodonate[nm] = fn
                 else:
                     fn = self._observe_jit[nm]
-                self._tstates[nm] = fn(tk.tstate, tk.cands, qor,
-                                       self.best)
+                with obs.device_span("ticket.observe", arm=nm):
+                    self._tstates[nm] = fn(tk.tstate, tk.cands, qor,
+                                           self.best)
             # else: the member was restarted while this ticket was in
             # flight — observing would write the pre-restart snapshot
             # back over the fresh state, silently undoing the restart
@@ -1104,12 +1130,14 @@ class Tuner:
             # folds fresh rows in via O(N^2) incremental extension, so
             # t_refit stays ~0 on the tell path.
             idx = jnp.asarray([tr.row for tr in live])
-            self.surrogate.observe(
-                np.asarray(self.space.features(tk.cands[idx])),
-                qor_np[np.asarray(idx)])
-            r0 = time.perf_counter()
-            self.surrogate.maybe_refit()
-            t_refit = time.perf_counter() - r0
+            with obs.span("surrogate.tick", arm=tk.arm_name) as so:
+                self.surrogate.observe(
+                    np.asarray(self.space.features(tk.cands[idx])),
+                    qor_np[np.asarray(idx)])
+                r0 = time.perf_counter()
+                self.surrogate.maybe_refit()
+                t_refit = time.perf_counter() - r0
+                so.set(t_refit_ms=round(t_refit * 1e3, 3))
         dropped = self._last_dropped
         if dropped and not self._cap_warned:
             self._cap_warned = True
@@ -1127,12 +1155,24 @@ class Tuner:
         self.t_eval_wait_total += t_wait
         self.t_refit_total += t_refit
         sm = self.surrogate
+        snap_v = int(getattr(sm, "snapshot_version", 0) or 0)
+        lag = int(getattr(sm, "refit_lag_rows", 0) or 0)
         stats = StepStats(self.steps, tk.arm_name, tk.cands.batch,
                           evaluated, self.sign * new, was_new_best,
                           tk.pruned, dropped, tk.t_propose, tk.t_dedup,
-                          t_wait, t_refit,
-                          int(getattr(sm, "snapshot_version", 0) or 0),
-                          int(getattr(sm, "refit_lag_rows", 0) or 0))
+                          t_wait, t_refit, snap_v, lag)
+        if obs.enabled():
+            obs.event("ticket.finalize", arm=tk.arm_name,
+                      evaluated=evaluated, withdrawn=withdrawn,
+                      new_best=was_new_best, step=self.steps)
+            obs.observe("driver.eval_wait_s", t_wait)
+            obs.gauge("surrogate.snapshot_version", snap_v)
+            obs.gauge("surrogate.refit_lag_rows", lag)
+            obs.gauge("driver.hist_dropped", dropped)
+            if was_new_best:
+                obs.count("driver.new_bests")
+            if withdrawn:
+                obs.count("driver.tickets_withdrawn")
         if self.hooks:
             if was_new_best:
                 res = self.result()

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import obs
 from ..space.spec import CandBatch
 from ..techniques.base import Best
 from .fused import EngineState, FusedEngine
@@ -252,7 +253,10 @@ class BatchedEngine:
             _run = shard_map(_local, mesh=self.mesh,
                              in_specs=(P(MESH_AXIS),),
                              out_specs=P(MESH_AXIS), check_rep=False)
-        fn = jax.jit(_run, donate_argnums=(0,) if donate else ())
+        fn = obs.instrument_device_fn(
+            jax.jit(_run, donate_argnums=(0,) if donate else ()),
+            "engine.batched_run", steps=n_steps,
+            n_instances=self.n_instances)
         self._compiled[sig] = fn
         return fn
 

@@ -25,6 +25,7 @@ from typing import Callable, List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..driver.history import History, HistState, dup_source
 from ..space.spec import CandBatch, Space, concat_cands
 from ..techniques.base import Best, Technique, get_technique
@@ -208,7 +209,11 @@ class FusedEngine:
         compile + cost analysis, as bench.py uses)."""
         def _run(s):
             return self.run(s, n_steps, eval_fn, exchange)
-        return jax.jit(_run, donate_argnums=(0,) if donate else ())
+        fn = jax.jit(_run, donate_argnums=(0,) if donate else ())
+        # each dispatch of the fused step loop is one span on the
+        # caller's lane (and a jax.profiler.TraceAnnotation, so a
+        # captured XLA profile lines up with the host trace)
+        return obs.instrument_device_fn(fn, "engine.run", steps=n_steps)
 
     def run_traced(self, state: EngineState,
                    n_steps: int) -> Tuple[EngineState, jax.Array]:

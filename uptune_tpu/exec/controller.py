@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import obs
 from ..api.constraint import REGISTRY
 from ..api.session import settings, write_best
 from ..api.state import DEFAULT_QOR_FILE, PARAMS_FILE
@@ -351,6 +352,10 @@ class ProgramTuner:
             else:
                 kept.append(tr)
         queue.extend(kept)
+        if n:
+            # count trials actually withdrawn, not new-best sweeps — a
+            # sweep that keeps everything invalidated nothing
+            obs.count("driver.spec_invalidations", n)
         return n
 
     # ------------------------------------------------------------------
@@ -402,8 +407,17 @@ class ProgramTuner:
         immediately — no build, but FULL accounting (told/evals budget,
         archive row, surrogate observation, bandit credit) and the same
         new-best speculative invalidation a pool result triggers."""
+        t0 = time.perf_counter()
         qor = self._verdict(row.get("qor"), trial.config)
         stats = self.tuner.tell(trial, qor, float(row.get("dur", 0.0)))
+        if obs.enabled():
+            # the bypass lane: a served ticket's gid shows up HERE and
+            # never on a worker-N build lane
+            obs.complete_span("store.serve_hit", t0=t0,
+                              dur=time.perf_counter() - t0,
+                              track="store", gid=trial.gid)
+            obs.observe("store.serve_ms",
+                        (time.perf_counter() - t0) * 1e3)
         if qor is not None:
             self._host_history.append((trial.config, qor))
         if stats is not None and stats.was_new_best and self.prefetch:
@@ -490,6 +504,8 @@ class ProgramTuner:
         injected = tuner.inject([row["cfg"]], source="exchange")
         if injected:
             self.exchange_injected += len(injected)
+            obs.event("store.exchange", qor=float(row["qor"]))
+            obs.count("store.exchange_injected", len(injected))
             # serve ahead of speculative technique work
             queue.extendleft(reversed(injected))
 
@@ -513,7 +529,8 @@ class ProgramTuner:
             time_limit: Optional[float] = None) -> TuneResult:
         """Tune end-to-end; returns the Tuner's TuneResult."""
         if self.params is None:
-            self.analyze()
+            with obs.span("controller.analyze"):
+                self.analyze()
         limit = int(test_limit if test_limit is not None
                     else self.test_limit)
         wall_limit = (time_limit if time_limit is not None
@@ -533,7 +550,8 @@ class ProgramTuner:
                 # was lost) still never re-execute an archived config
                 store.ingest_archive(self.archive)
             if self.warm_start:
-                self._warm_start_from_store()
+                with obs.span("controller.warm_start") as sp:
+                    sp.set(rows=self._warm_start_from_store())
 
         queue: collections.deque = collections.deque()
         # seed trial: the program's declared defaults; its QoR was already
@@ -628,6 +646,7 @@ class ProgramTuner:
                                limit - tuner.told - outstanding)
                     asked = tuner.ask(min_trials=want)
                     queue.extend(asked)
+                    obs.gauge("prefetch.depth", len(queue))
                     dry_asks = 0 if asked else dry_asks + 1
                     if asked and pool.n_free:
                         continue  # launch the fresh trials before polling

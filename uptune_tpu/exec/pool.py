@@ -25,14 +25,15 @@ import subprocess
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import obs
 from .measure import _preexec, kill_process_group
 
 PROTOCOL_FILES = ("ut.params.json",)   # copied (not symlinked) per sandbox
 
 
 class _Slot:
-    __slots__ = ("index", "sandbox", "proc", "trial", "t0", "deadline",
-                 "stage", "log_f", "err_f")
+    __slots__ = ("index", "sandbox", "proc", "trial", "t0", "t0p",
+                 "deadline", "stage", "log_f", "err_f")
 
     def __init__(self, index: int, sandbox: str):
         self.index = index
@@ -40,6 +41,7 @@ class _Slot:
         self.proc: Optional[subprocess.Popen] = None
         self.trial = None
         self.t0 = 0.0
+        self.t0p = 0.0       # perf_counter at launch (obs build span)
         self.deadline = float("inf")
         self.stage = 0
         self.log_f = None
@@ -211,10 +213,13 @@ class WorkerPool:
             preexec_fn=_preexec(self.memory_limit))
         slot.trial = trial
         slot.t0 = time.time()
+        slot.t0p = time.perf_counter()
         slot.deadline = (slot.t0 + self.runtime_limit
                          if self.runtime_limit else float("inf"))
         slot.stage = stage
         self.launched += 1
+        obs.count("pool.launched")
+        obs.gauge("pool.busy", self.busy_count)
         return slot.index
 
     # ------------------------------------------------------------------
@@ -246,6 +251,21 @@ class WorkerPool:
         info = {"returncode": rc, "timeout": killed, "slot": slot.index,
                 "sandbox": slot.sandbox}
         trial = slot.trial
+        # the build window on this slot's trace lane (emitted at reap
+        # time from the polling thread, with the slot's own launch
+        # timestamp): store-hit trials never reach a slot, so their
+        # absence from worker lanes is the bypass made visible.  The
+        # span stays entirely on the perf_counter timebase (t0p) — the
+        # wall-clock `dur` above can go negative across an NTP step
+        pdur = time.perf_counter() - slot.t0p
+        obs.complete_span(
+            "pool.build", t0=slot.t0p, dur=pdur,
+            track=f"worker-{self.slot_prefix}{slot.index}",
+            gid=getattr(trial, "gid", None), rc=rc, timeout=killed)
+        obs.observe("pool.build_s", pdur)
+        obs.gauge("pool.utilization", self.utilization())
+        if killed:
+            obs.count("pool.timeouts")
         slot.proc = slot.trial = slot.log_f = slot.err_f = None
         slot.deadline = float("inf")
         if killed:

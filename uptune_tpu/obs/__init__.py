@@ -1,0 +1,99 @@
+"""Unified observability plane: cross-plane span tracing + metrics.
+
+Every asynchronous plane this repo grew (PR 2 prefetch, PR 4 store,
+PR 5 background refit, PR 6 batched engine) is instrumented through
+this package's tiny module-level API:
+
+    from uptune_tpu import obs
+
+    with obs.span("ticket.propose", arm=name):   # timed span
+        ...
+    obs.event("ticket.open", gid=gid)            # instant event
+    obs.count("store.hit")                       # counter
+    obs.gauge("prefetch.depth", len(queue))      # gauge
+    obs.observe("store.serve_ms", dt * 1e3)      # histogram
+
+Everything is a no-op until `obs.enable()` (or a `--trace` / `UT_TRACE`
+run): the disabled path is one module-flag check and allocates nothing,
+so instrumentation stays in the hot paths permanently (BENCH_OBS.json
+holds the measured cost of both paths).  When enabled, each thread
+records into its own lock-free ring buffer; exporters turn the rings
+into a Perfetto-viewable Chrome trace (one lane per thread / worker
+slot), a metrics JSONL, and a text summary.  See docs/OBSERVABILITY.md
+for the span taxonomy and metric names.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .core import (DEFAULT_CAPACITY, complete_span, device_span,
+                   disable, enable, enabled, event, now, reset,
+                   snapshot, span, trace_origin_unix)
+from .export import (chrome_trace, text_summary, validate_trace,
+                     write_metrics_jsonl, write_trace)
+from .metrics import count, counter_value, gauge, observe
+from .metrics import snapshot as metrics_snapshot
+
+__all__ = [
+    "enabled", "enable", "disable", "reset", "span", "device_span",
+    "event", "complete_span", "count", "gauge", "observe", "snapshot",
+    "metrics_snapshot", "chrome_trace", "write_trace",
+    "write_metrics_jsonl", "text_summary", "validate_trace", "now",
+    "trace_origin_unix", "maybe_enable_from_env", "finish",
+    "instrument_device_fn", "DEFAULT_CAPACITY",
+]
+
+
+def instrument_device_fn(fn, name: str, **attrs):
+    """Wrap a jitted callable so every invocation records a
+    `device_span` (host span + jax.profiler.TraceAnnotation) — the
+    engine plane's seam: the whole fused/batched step loop is ONE
+    compiled program, so its observability unit is the dispatch call.
+    The `.lower` attribute is forwarded for AOT compile / cost-analysis
+    paths (bench.py); when tracing is disabled the wrapper costs one
+    flag check."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        if not enabled():
+            return fn(*a, **kw)
+        with device_span(name, **attrs):
+            return fn(*a, **kw)
+
+    if hasattr(fn, "lower"):
+        wrapper.lower = fn.lower
+    return wrapper
+
+
+def maybe_enable_from_env(env: Optional[dict] = None) -> Optional[str]:
+    """`UT_TRACE=<path>` turns tracing on for this process (bench.py /
+    `ut` CLI hook; the CLI's `--trace` flag and `ut.config('trace')`
+    layer above it).  Returns the trace output path when enabled,
+    None otherwise.  `UT_TRACE=1` enables recording without a
+    default output path (callers export explicitly)."""
+    e = os.environ if env is None else env
+    val = e.get("UT_TRACE", "").strip()
+    if not val or val.lower() in ("0", "off", "false", "none"):
+        return None
+    enable()
+    return None if val.lower() in ("1", "true", "yes", "on") else val
+
+
+def finish(path: Optional[str],
+           extra: Optional[Dict[str, Any]] = None,
+           metrics_path: Optional[str] = None) -> Optional[dict]:
+    """End-of-run export: write the Chrome trace to `path`, append one
+    metrics-snapshot line next to it (`<path>.metrics.jsonl` unless
+    `metrics_path` overrides), and return the trace document.  A None
+    path skips the files (summary-only callers).  Recording stays
+    enabled — callers own disable()/reset()."""
+    if not enabled():
+        return None
+    doc = None
+    if path:
+        doc = write_trace(path, extra=extra)
+        write_metrics_jsonl(metrics_path or path + ".metrics.jsonl",
+                            extra={"trace": os.path.basename(path)})
+    return doc

@@ -1,0 +1,207 @@
+"""Exporters: Chrome trace-event JSON (Perfetto), metrics JSONL, text
+summary.
+
+The trace JSON follows the Chrome trace-event format's flavor that
+Perfetto ingests directly (https://ui.perfetto.dev -> open file):
+
+* one ``pid`` (the tuning process), one ``tid`` per LANE — a lane is
+  either a real thread (driver MainThread, the ``ut-surrogate-refit``
+  worker) or a synthetic track (``worker-N`` build slots, emitted by
+  the driver thread at reap time with the slot's own timestamps) — so
+  the background refit and every WorkerPool slot render as horizontal
+  lanes against the driver's ticket spans;
+* complete spans are ``"ph": "X"`` events with microsecond ``ts`` /
+  ``dur``; instants are ``"ph": "i"`` scope-thread events; lane names
+  arrive as ``"ph": "M"`` thread_name metadata records.
+
+`validate_trace` is the schema contract: the round-trip test and the
+committed example artifact are both held to it.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from . import core, metrics
+
+__all__ = ["chrome_trace", "write_trace", "write_metrics_jsonl",
+           "text_summary", "validate_trace"]
+
+PID = 1
+
+
+def _lane_order(track: str) -> tuple:
+    """Sort key: driver thread first, worker slots next (numeric), then
+    auxiliary threads (refit worker, ...)."""
+    if track == "MainThread":
+        return (0, 0, track)
+    if track.startswith("worker-"):
+        try:
+            return (1, int(track.split("-", 1)[1]), track)
+        except ValueError:
+            return (1, 1 << 30, track)
+    return (2, 0, track)
+
+
+def chrome_trace(snap: Optional[Dict[str, Any]] = None,
+                 extra: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """Build the Chrome trace-event document from a core snapshot
+    (default: the live rings) plus the metrics snapshot."""
+    if snap is None:
+        snap = core.snapshot()
+    tracks: List[str] = []
+    for e in snap["events"]:
+        if e["track"] not in tracks:
+            tracks.append(e["track"])
+    tracks.sort(key=_lane_order)
+    tid_of = {t: i + 1 for i, t in enumerate(tracks)}
+    events: List[Dict[str, Any]] = []
+    for t, tid in tid_of.items():
+        events.append({"ph": "M", "pid": PID, "tid": tid,
+                       "name": "thread_name", "args": {"name": t}})
+        events.append({"ph": "M", "pid": PID, "tid": tid,
+                       "name": "thread_sort_index",
+                       "args": {"sort_index": _lane_order(t)[0] * 1000
+                                + _lane_order(t)[1]}})
+    for e in snap["events"]:
+        rec: Dict[str, Any] = {
+            "name": e["name"],
+            "cat": e["name"].split(".", 1)[0],
+            "pid": PID,
+            "tid": tid_of[e["track"]],
+            "ts": round(e["ts"] * 1e6, 3),
+        }
+        if e["dur"] is None:
+            rec["ph"] = "i"
+            rec["s"] = "t"
+        else:
+            rec["ph"] = "X"
+            rec["dur"] = round(e["dur"] * 1e6, 3)
+        if e["attrs"]:
+            rec["args"] = e["attrs"]
+        events.append(rec)
+    other: Dict[str, Any] = {
+        "origin_unix": snap.get("origin_unix", 0.0),
+        "dropped": snap.get("dropped", {}),
+        "metrics": metrics.snapshot(),
+    }
+    if extra:
+        other.update(extra)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def write_trace(path: str, extra: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+    """Write the Perfetto-viewable trace JSON; returns the document."""
+    doc = chrome_trace(extra=extra)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def write_metrics_jsonl(path: str,
+                        extra: Optional[Dict[str, Any]] = None) -> None:
+    """Append ONE metrics-snapshot line (a scrape row): counters,
+    gauges, histogram summaries, wall-clock timestamp."""
+    row = {"t": round(time.time(), 3), **metrics.snapshot()}
+    if extra:
+        row.update(extra)
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def validate_trace(doc: Any) -> None:
+    """Schema contract for the exported trace (raises ValueError):
+    every event has ph/pid/tid/name; X events carry numeric ts and
+    dur >= 0; instants carry ts; every tid used by a timed event has a
+    thread_name metadata record."""
+    def fail(msg):
+        raise ValueError(f"trace schema: {msg}")
+
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        fail("document must be a dict with a 'traceEvents' list")
+    evs = doc["traceEvents"]
+    if not isinstance(evs, list):
+        fail("'traceEvents' must be a list")
+    named_tids = set()
+    used_tids = set()
+    for i, e in enumerate(evs):
+        if not isinstance(e, dict):
+            fail(f"event {i} is not an object")
+        for k in ("ph", "pid", "tid", "name"):
+            if k not in e:
+                fail(f"event {i} missing {k!r}")
+        if e["ph"] == "M":
+            if e["name"] == "thread_name":
+                if not e.get("args", {}).get("name"):
+                    fail(f"event {i}: thread_name without args.name")
+                named_tids.add(e["tid"])
+            continue
+        if e["ph"] not in ("X", "i", "C"):
+            fail(f"event {i}: unknown phase {e['ph']!r}")
+        if not isinstance(e.get("ts"), (int, float)):
+            fail(f"event {i}: non-numeric ts")
+        used_tids.add(e["tid"])
+        if e["ph"] == "X":
+            d = e.get("dur")
+            if not isinstance(d, (int, float)) or d < 0:
+                fail(f"event {i}: X event needs dur >= 0")
+        if "args" in e:
+            try:
+                json.dumps(e["args"])
+            except (TypeError, ValueError):
+                fail(f"event {i}: args not JSON-serializable")
+    missing = used_tids - named_tids
+    if missing:
+        fail(f"tids {sorted(missing)} have events but no thread_name "
+             f"metadata (lanes would be anonymous in Perfetto)")
+
+
+def text_summary(snap: Optional[Dict[str, Any]] = None) -> str:
+    """End-of-run human summary: per-span-name count/total/mean, the
+    counters and gauges, histogram percentiles, and drop warnings."""
+    if snap is None:
+        snap = core.snapshot()
+    per: Dict[str, List[float]] = {}
+    insts: Dict[str, int] = {}
+    for e in snap["events"]:
+        if e["dur"] is None:
+            insts[e["name"]] = insts.get(e["name"], 0) + 1
+        else:
+            per.setdefault(e["name"], []).append(e["dur"])
+    lines = ["== obs summary =="]
+    if per:
+        lines.append("spans (count / total s / mean ms):")
+        for name in sorted(per):
+            ds = per[name]
+            lines.append(f"  {name:<28} {len(ds):>6}  "
+                         f"{sum(ds):>9.3f}  "
+                         f"{1e3 * sum(ds) / len(ds):>9.3f}")
+    if insts:
+        lines.append("events:")
+        for name in sorted(insts):
+            lines.append(f"  {name:<28} {insts[name]:>6}")
+    m = metrics.snapshot()
+    if m["counters"]:
+        lines.append("counters:")
+        for k in sorted(m["counters"]):
+            lines.append(f"  {k:<28} {m['counters'][k]:>10g}")
+    if m["gauges"]:
+        lines.append("gauges:")
+        for k in sorted(m["gauges"]):
+            lines.append(f"  {k:<28} {m['gauges'][k]:>10g}")
+    if m["hists"]:
+        lines.append("histograms:")
+        for k in sorted(m["hists"]):
+            h = m["hists"][k]
+            lines.append(
+                f"  {k:<28} n={h['count']} mean={h['mean']} "
+                f"p50={h.get('p50')} p95={h.get('p95')} "
+                f"max={h['max']}")
+    if snap.get("dropped"):
+        lines.append(f"DROPPED events (ring capacity exceeded): "
+                     f"{snap['dropped']}")
+    return "\n".join(lines)

@@ -38,6 +38,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
+from .. import obs
 from .keys import eval_signature, scope_id, trial_key
 
 
@@ -182,7 +183,10 @@ class ResultStore:
         never re-read — its rows entered memory at record() time), so a
         truthy refresh really means siblings produced something."""
         self._last_refresh = time.monotonic()
-        return self._load_all()
+        with obs.span("store.refresh") as sp:
+            n = self._load_all()
+            sp.set(rows=n)
+        return n
 
     def maybe_refresh(self) -> int:
         """Time-gated refresh() for call sites inside hot loops."""
@@ -201,8 +205,10 @@ class ResultStore:
         row = self._rows.get(trial_key(self.scope, cfg))
         if row is not None and _finite(row.get("qor")):
             self.hits += 1
+            obs.count("store.hits")
             return row
         self.misses += 1
+        obs.count("store.misses")
         return None
 
     def scope_rows(self) -> List[Dict[str, Any]]:
@@ -269,6 +275,7 @@ class ResultStore:
         self._append(row)
         self._rows[k] = row
         self.recorded += 1
+        obs.count("store.recorded")
         return row
 
     def ingest_archive(self, path: str) -> int:

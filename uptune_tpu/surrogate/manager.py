@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import obs
 from ..space.spec import CandBatch, Space
 from . import gp as gp_mod
 from . import mlp as mlp_mod
@@ -444,6 +445,7 @@ class SurrogateManager:
                         self._refit_exec = ThreadPoolExecutor(
                             max_workers=1,
                             thread_name_prefix="ut-surrogate-refit")
+                    obs.event("surrogate.submit", n_rows=self.n_points)
                     self._refit_future = self._refit_exec.submit(
                         self._refit_full, *args, background=True)
                 else:
@@ -510,6 +512,24 @@ class SurrogateManager:
         program per bucket (fit_auto hyperparameter sweep when
         hyper_fit), then publish one immutable snapshot."""
         t0 = time.perf_counter()
+        # the fit span lands on the CALLING thread's lane: the refit
+        # worker under async_refit (rendering as its own Perfetto lane
+        # overlapping driver ticket spans), the driver thread for
+        # forced-sync fits
+        sp_obs = obs.span("surrogate.fit", background=background,
+                          n_rows=len(ys_np))
+        sp_obs.__enter__()
+        try:
+            self._refit_full_body(xs_np, ys_np, ks, kf, background,
+                                  t0, sp_obs)
+        finally:
+            # a failed fit (the PR 5 warn + re-arm path) must still
+            # close its span: the refit-worker lane has to show WHERE
+            # the time went, not go blank on the runs being debugged
+            sp_obs.__exit__(None, None, None)
+
+    def _refit_full_body(self, xs_np, ys_np, ks, kf, background,
+                         t0, sp_obs) -> None:
         n_total = len(ys_np)
         xs_sub, ys_sub = self._host_subsample(xs_np, ys_np,
                                               ks, self.max_points)
@@ -569,6 +589,9 @@ class SurrogateManager:
                 state, self._version, n_total, thr, besty,
                 exact=n_total <= self.max_points, in_bucket=n)
             self.refits += 1
+        obs.event("surrogate.publish", version=self._version,
+                  n_rows=n_total, bucket=bucket)
+        obs.gauge("surrogate.refits_published", self.refits)
         ext = self._ext_jit.get(bucket)
         if ext is not None and n < bucket and n_total <= self.max_points:
             # warm the extension wrapper for THIS bucket on the refit
@@ -581,6 +604,7 @@ class SurrogateManager:
                 jnp.float32(besty if besty is not None else 0.0),
                 jnp.int32(n)))
         dt = time.perf_counter() - t0
+        sp_obs.set(bucket=bucket)
         if background:
             self.t_refit_bg_total += dt
         else:
@@ -657,6 +681,7 @@ class SurrogateManager:
         if worst is None:
             return 0
         st, rows, i = snap.state, 0, snap.n_rows
+        t0_obs = time.perf_counter()
         while i < n and snap.in_bucket + rows < bucket \
                 and rows < self._ext_per_tick:
             q = ys[i] if np.isfinite(ys[i]) else worst
@@ -681,6 +706,10 @@ class SurrogateManager:
                 threshold=thr, best_y=besty,
                 in_bucket=snap.in_bucket + rows)
         self.incr_updates += rows
+        if rows:
+            obs.complete_span("surrogate.extend", t0=t0_obs,
+                              dur=time.perf_counter() - t0_obs,
+                              rows=rows, version=self._version)
         return rows
 
     def force_refit(self) -> bool:
