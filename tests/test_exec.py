@@ -116,6 +116,10 @@ class TestProgramTuner:
         # default (50,50): (13)^2 + (39)^2
         assert pt.default_qor == 13 ** 2 + 39 ** 2
 
+    @pytest.mark.slow   # suite-budget (ISSUE 8): the driver e2e is
+    # also covered tier-1 by test_store's full `ut` CLI strict-guard
+    # run (superset: CLI + store + trace) and this class's faster
+    # constraint/budget/timeout/prefetch cases
     def test_end_to_end_tunes_and_persists_best(self, tmp_path):
         pt = _mk_tuner(tmp_path, QUAD_PROG, test_limit=40, seed=1)
         res = pt.run()

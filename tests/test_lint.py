@@ -620,7 +620,7 @@ class TestTraceGuard:
 
 # ------------------------------------------------------- repo gate
 @pytest.mark.parametrize("package", ["store", "surrogate", "engine",
-                                     "ops", "obs"])
+                                     "ops", "obs", "serve"])
 def test_package_suppression_free(package):
     """Packages on the correctness-critical fast path must be finding-
     AND suppression-free: no '# ut-lint: disable' escape hatch, no
@@ -633,8 +633,10 @@ def test_package_suppression_free(package):
     invalidate every BENCH_* headline measured through them; obs/ is
     instrumentation living INSIDE every hot path (ISSUE 7) — a
     silenced hazard there would tax or skew the measurements it
-    exists to make.  lint.sh enforces the same in the pre-commit
-    gate."""
+    exists to make; serve/ multiplexes every tenant onto three shared
+    compiled programs (ISSUE 8) — a silenced retrace or host-sync
+    hazard there stalls ALL sessions at once.  lint.sh enforces the
+    same in the pre-commit gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",
          os.path.join(REPO, "uptune_tpu", package),

@@ -114,6 +114,8 @@ class TestRegressions:
         with pytest.raises(ValueError, match="16 training rows"):
             QuickEst().fit(x, y[:, 0], ["T"])
 
+    @pytest.mark.slow   # suite-budget (ISSUE 8): seed plumbing only,
+    # but pays two full fits; fit behavior stays tier-1 in TestQuickEst
     def test_seed_option_accepted(self):
         x, y = _dataset(n=60)
         est = QuickEst(seed=3, mlp_steps=50).fit(x, y, ["A", "B"])
@@ -157,6 +159,9 @@ class TestAnalyze:
         assert "feat0" in lut["__selected__"]
         assert (tmp_path / "feature_importance.csv").exists()
 
+    @pytest.mark.slow   # suite-budget (ISSUE 8): statistical trend on
+    # repeated fits; model quality stays tier-1 via TestQuickEst::
+    # test_accuracy and TestAnalyze's scores/feature-importance cases
     def test_learning_curve_improves_with_data(self, tmp_path):
         from uptune_tpu.quickest import learning_curve
         x, y = _dataset(n=160)

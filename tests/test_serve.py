@@ -1,0 +1,529 @@
+"""Tuning-as-a-service tests (uptune_tpu/serve, docs/SERVING.md).
+
+Coverage map:
+* wire bridge round trip (records_from_space <-> space_from_params)
+* `serve-*` ut.config keys and the flags > ut.config > DEFAULTS
+  precedence contract (mirrors the store/trace key tests)
+* session mechanics on the offline single-slot group: versioned
+  epochs, lazy memo/dedup scan, stale tickets, failure QoRs
+* server protocol: transport-free handle() dispatch, real TCP
+  client, metrics scrape (the obs plane's serving seam), admission
+* ISOLATION + PARITY: concurrently driven server sessions bitwise
+  equal to sequential offline sessions at matched seeds (the
+  multiplexing contract; soak version with churn slow-marked)
+* cross-tenant memo: one tenant's recorded builds serve another's
+  ask; program tokens scope the sharing
+* strict no-retrace: join/leave/ask/tell churn rides three compiled
+  programs, each traced exactly once
+* `bench.py --serve --quick` tier-1 smoke
+
+Engine groups compile three programs each (~seconds), so the suite
+shares ONE server (module scope) and ONE offline single-slot group;
+the offline group is reused across seeds via join/leave, which is
+exactly LocalSession's machinery (their identity is asserted in the
+slow soak).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from uptune_tpu.api import session as api_session  # noqa: E402
+from uptune_tpu.exec.space_io import (  # noqa: E402
+    records_from_space, space_from_params)
+from uptune_tpu.serve import (  # noqa: E402
+    LocalSession, ServeError, SessionServer, connect)
+from uptune_tpu.serve.cli import build_parser, resolve_config  # noqa: E402
+from uptune_tpu.serve.group import SessionGroup, group_key  # noqa: E402
+from uptune_tpu.serve.session import StaleTicketError  # noqa: E402
+from uptune_tpu.workloads import rosenbrock_space  # noqa: E402
+
+DIMS = 2
+
+
+def _space():
+    return rosenbrock_space(DIMS, -3.0, 3.0)
+
+
+def _measure(cfg):
+    x = np.array([cfg[f"x{i}"] for i in range(DIMS)])
+    return float(np.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                        + (1 - x[:-1]) ** 2))
+
+
+def _drive_epochs(sess, epochs, chunk=7):
+    """Interleaved chunked ask/tell until the session advances `epochs`
+    versions past where it started; returns the full offered-config
+    trajectory (the bitwise parity evidence).  Progress is measured on
+    ``sess.version``, not on commits observed via tell: a fully
+    memo-served epoch auto-commits with ZERO tells (ask returns [] and
+    the version jumps), and counting tell-side commits would overdrive
+    the session past the target."""
+    offered = []
+    target = sess.version + epochs
+    while sess.version < target:
+        trials = sess.ask(chunk)
+        if not trials:      # memo auto-committed; version re-checked
+            continue
+        offered.extend(t.config for t in trials)
+        for t in trials:
+            sess.tell(t.ticket, _measure(t.config))
+    return offered
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    """One shared server: 8-slot groups, memo store on."""
+    store = str(tmp_path_factory.mktemp("serve_store"))
+    srv = SessionServer(host="127.0.0.1", port=0, slots=8,
+                        max_sessions=64, store_dir=store).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def offline():
+    """One shared single-slot group, reused across seeds via
+    join/leave — the sequential offline baseline."""
+    return SessionGroup(_space(), 1)
+
+
+class TestWireBridge:
+    def test_records_roundtrip_signature(self):
+        sp = _space()
+        recs = records_from_space(sp)
+        assert json.loads(json.dumps(recs)) == recs   # JSON-clean
+        sp2 = space_from_params(recs)
+        assert sp2.signature() == sp.signature()
+
+    def test_roundtrip_covers_param_kinds(self):
+        from uptune_tpu.space import params as P
+        from uptune_tpu.space.spec import Space
+        sp = Space([
+            P.IntParam("i", 1, 9), P.FloatParam("f", 0.0, 1.0),
+            P.BoolParam("b"), P.Pow2Param("p", 1, 16),
+            P.EnumParam("e", ["a", "c"]),
+            P.PermParam("perm", [0, 1, 2]),
+        ])
+        sp2 = space_from_params(records_from_space(sp))
+        assert sp2.signature() == sp.signature()
+
+
+class TestConfigKeys:
+    def test_defaults_have_serve_keys(self):
+        for k in ("serve-host", "serve-port", "serve-slots",
+                  "serve-max-sessions", "serve-store-dir"):
+            assert k in api_session.DEFAULTS
+
+    def test_precedence_flags_over_config_over_defaults(self):
+        """CLI flags > ut.config > DEFAULTS for the new subcommand
+        (same contract, same test shape as the store/trace keys)."""
+        import uptune_tpu as ut
+        try:
+            # default layer
+            args = build_parser().parse_args([])
+            assert resolve_config(args)["port"] == \
+                api_session.DEFAULTS["serve-port"]
+            # ut.config layer overrides the default
+            ut.config({"serve-port": 9100, "serve-slots": 3})
+            cfg = resolve_config(build_parser().parse_args([]))
+            assert cfg["port"] == 9100 and cfg["slots"] == 3
+            # explicit flag beats ut.config
+            cfg = resolve_config(build_parser().parse_args(
+                ["--port", "9200", "--store-dir", "off"]))
+            assert cfg["port"] == 9200
+            assert cfg["slots"] == 3
+            assert cfg["store_dir"] == "off"
+        finally:
+            api_session.reset_settings()
+
+    def test_server_constructor_reads_settings(self):
+        try:
+            api_session.settings["serve-slots"] = 5
+            api_session.settings["serve-max-sessions"] = 7
+            api_session.settings["serve-store-dir"] = "off"
+            srv = SessionServer(port=0)     # not started: no sockets
+            assert srv.slots == 5 and srv.max_sessions == 7
+            assert srv.store_dir is None
+        finally:
+            api_session.reset_settings()
+
+    def test_bad_slots_rejected(self):
+        with pytest.raises(ValueError):
+            SessionServer(port=0, slots=0)
+
+    def test_ut_cli_dispatches_serve(self):
+        """`ut serve ...` routes to the serve subcommand's own parser
+        (argparse --help exits 0 before any server is constructed)."""
+        from uptune_tpu import cli
+        with pytest.raises(SystemExit) as e:
+            cli.main(["serve", "--help"])
+        assert e.value.code == 0
+
+
+class TestSessionMechanics:
+    def test_versioned_epochs_and_dedup(self, offline):
+        s = offline.join(seed=11)
+        try:
+            assert s.version == 0
+            seen = {}
+            told = 0
+            while True:
+                trials = s.ask(5)
+                if not trials:
+                    assert told > 0
+                    break
+                for t in trials:
+                    key = json.dumps(t.config, sort_keys=True)
+                    # in-epoch duplicates never get a second ticket
+                    assert key not in seen
+                    seen[key] = t.ticket
+                    r = s.tell(t.ticket, _measure(t.config))
+                    told += 1
+                    if r["committed"]:
+                        break
+                if s.version:
+                    break
+            assert s.version == 1
+            # a ticket from the published-over epoch is stale
+            with pytest.raises(StaleTicketError):
+                s.tell(next(iter(seen.values())), 1.0)
+        finally:
+            s.close()
+
+    def test_failure_qor_never_becomes_best(self, offline):
+        s = offline.join(seed=12)
+        try:
+            trials = s.ask(4)
+            s.tell(trials[0].ticket, None)          # build failure
+            s.tell(trials[1].ticket, float("inf"))  # unbounded
+            assert s.best()["qor"] is None
+            s.tell(trials[2].ticket, 3.25)
+            assert s.best()["qor"] == 3.25
+        finally:
+            s.close()
+
+    def test_malformed_qor_leaves_ticket_live(self, offline):
+        """A non-numeric qor must fail WITHOUT consuming the ticket:
+        popping first would strand the epoch one row short of settled
+        forever (the session could never commit or ask again)."""
+        s = offline.join(seed=14)
+        try:
+            t = s.ask(1)[0]
+            with pytest.raises((TypeError, ValueError)):
+                s.tell(t.ticket, "oops")
+            r = s.tell(t.ticket, 1.5)       # retry succeeds
+            assert s.best()["qor"] == 1.5
+            assert r["version"] == s.version
+        finally:
+            s.close()
+
+    def test_closed_session_rejects_ops(self, offline):
+        s = offline.join(seed=13)
+        s.close()
+        with pytest.raises(StaleTicketError):
+            s.ask(1)
+        # slot is back in the pool
+        assert offline.n_free == 1
+
+    def test_group_key_identity(self):
+        sp = _space()
+        assert group_key(sp, None, "min", 64) == \
+            group_key(space_from_params(records_from_space(sp)),
+                      None, "min", 64)
+        assert group_key(sp, None, "min", 64) != \
+            group_key(sp, None, "max", 64)
+
+
+class TestServerProtocol:
+    def test_handle_rejects_garbage(self, server):
+        assert server.handle(["nope"])["ok"] is False
+        assert "unknown op" in server.handle({"op": "zap"})["error"]
+        r = server.handle({"op": "ask", "session": "missing"})
+        assert r["ok"] is False and "unknown session" in r["error"]
+        r = server.handle({"op": "open", "space": []})
+        assert r["ok"] is False
+        r = server.handle({"op": "open",
+                           "space": [{"name": "x", "type": "wat"}],
+                           "id": 7})
+        assert r["ok"] is False and r["id"] == 7
+        recs = records_from_space(_space())
+        r = server.handle({"op": "open", "space": recs,
+                           "sense": "sideways"})
+        assert r["ok"] is False and "sense" in r["error"]
+
+    def test_admission_limit(self, server):
+        old = server.max_sessions
+        server.max_sessions = server.n_sessions
+        try:
+            r = server.handle({"op": "open",
+                               "space": records_from_space(_space())})
+            assert r["ok"] is False and "full" in r["error"]
+        finally:
+            server.max_sessions = old
+
+    def test_tcp_open_ask_tell_best_close(self, server):
+        with connect(("127.0.0.1", server.port)) as c:
+            assert c.ping()["ok"]
+            with c.open_session(_space(), seed=21, program="tcp-e2e",
+                                store=False) as h:
+                trials = h.ask(6)
+                assert len(trials) == 6
+                qs = [_measure(t.config) for t in trials]
+                r = h.tell_many(zip((t.ticket for t in trials), qs))
+                assert r["told"] == 6
+                b = h.best()
+                assert b["qor"] == min(qs)
+                # stale/bogus ticket is an error, not a crash
+                with pytest.raises(ServeError):
+                    h.tell(10 ** 9, 1.0)
+
+    def test_dead_connection_reaps_its_sessions(self, server):
+        """A client that crashes without op:close must not hold its
+        slot + admission unit forever: session lifetime is
+        connection-scoped, the server reaps on disconnect."""
+        before = server.n_sessions
+        c = connect(("127.0.0.1", server.port))
+        c.open_session(_space(), seed=24, store=False)
+        assert server.n_sessions == before + 1
+        c.close()   # socket drop, no {"op": "close"} sent
+        deadline = time.time() + 5.0
+        while server.n_sessions > before and time.time() < deadline:
+            time.sleep(0.02)
+        assert server.n_sessions == before
+
+    def test_metrics_scrape_is_obs_snapshot(self, server):
+        """The `metrics` op serves obs.metrics.snapshot() — the seam
+        PR 7 left open — including the server's own gauges/hists."""
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=22, store=False) as h:
+                for t in h.ask(3):
+                    h.tell(t.ticket, _measure(t.config))
+                m = c.metrics()
+        snap = m["metrics"]
+        assert m["sessions"] >= 1
+        assert snap["counters"]["serve.asks"] >= 3
+        assert snap["counters"]["serve.tells"] >= 3
+        assert snap["gauges"]["serve.sessions.active"] >= 1
+        assert snap["hists"]["serve.ask_ms"]["count"] >= 1
+        assert "p95" in snap["hists"]["serve.ask_ms"]
+
+    def test_stats_op(self, server):
+        st = server.handle({"op": "stats"})
+        assert st["ok"] and isinstance(st["groups"], list)
+
+    def test_unhashable_op_is_an_error_reply(self, server):
+        r = server.handle({"op": ["ask"]})
+        assert r["ok"] is False and "unknown op" in r["error"]
+
+    def test_batch_tell_applies_elementwise(self, server):
+        """One bad ticket in a `results` batch must not strand the
+        good elements: they are told server-side, the failure comes
+        back in `errors`, and the epoch can still settle."""
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=23, store=False) as h:
+                trials = h.ask(3)
+                r = h.tell_many([(trials[0].ticket,
+                                  _measure(trials[0].config)),
+                                 (10 ** 9, 1.0)])
+                assert r["told"] == 1
+                assert r["errors"][0]["ticket"] == 10 ** 9
+                for t in trials[1:]:
+                    h.tell(t.ticket, _measure(t.config))
+
+
+class TestIsolationParity:
+    SEEDS = (101, 202, 303, 404)
+
+    def test_threaded_server_matches_sequential_offline(self, server,
+                                                        offline):
+        """THE multiplexing contract: N sessions driven CONCURRENTLY
+        over TCP (interleaved chunked ask/tell, one group, shared
+        epochs) produce per-session trajectories and incumbents
+        bitwise equal to the same seeds driven sequentially on the
+        offline single-slot group."""
+        results = {}
+        errors = []
+
+        def run(seed):
+            try:
+                with connect(("127.0.0.1", server.port)) as c:
+                    with c.open_session(_space(), seed=seed,
+                                        store=False) as h:
+                        offered = _drive_epochs(h, epochs=2)
+                        results[seed] = (offered, h.best())
+            except Exception as e:   # surfaced below
+                errors.append((seed, repr(e)))
+
+        ts = [threading.Thread(target=run, args=(s,))
+              for s in self.SEEDS]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors
+
+        for seed in self.SEEDS:
+            s = offline.join(seed=seed)
+            try:
+                offered = _drive_epochs(s, epochs=2)
+                best = s.best()
+            finally:
+                s.close()
+            got_offered, got_best = results[seed]
+            assert got_offered == offered, f"seed {seed} diverged"
+            assert got_best["qor"] == best["qor"]
+            assert got_best["config"] == best["config"]
+            assert got_best["version"] == best["version"] == 2
+
+    @pytest.mark.slow
+    def test_soak_parity_with_churn_and_memo(self, tmp_path):
+        """Soak: 12 sessions on a fresh server, 3 epochs, mid-run
+        close/reopen churn, memo ON — per-seed bests still bitwise
+        equal to LocalSession (same seeds, memo changes who BUILDS a
+        row, never its value), and LocalSession is the same machinery
+        as the shared offline group."""
+        srv = SessionServer(host="127.0.0.1", port=0, slots=4,
+                            max_sessions=64,
+                            store_dir=str(tmp_path / "memo")).start()
+        try:
+            seeds = list(range(500, 512))
+            results = {}
+            lock = threading.Lock()
+
+            def run(my):
+                with connect(("127.0.0.1", srv.port)) as c:
+                    for i, seed in enumerate(my):
+                        h = c.open_session(_space(), seed=seed,
+                                           program="soak")
+                        _drive_epochs(h, epochs=1)
+                        if i % 2:       # churn: leave + rejoin
+                            h.close()
+                            h = c.open_session(_space(), seed=seed,
+                                               program="soak")
+                            # memo replays epoch 1; drive to epoch 3
+                            _drive_epochs(h, epochs=3)
+                        else:
+                            _drive_epochs(h, epochs=2)
+                        with lock:
+                            results[seed] = h.best()
+                        h.close()
+
+            ts = [threading.Thread(target=run,
+                                   args=(seeds[i::3],))
+                  for i in range(3)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert len(results) == len(seeds)
+        finally:
+            srv.stop()
+
+        ref = LocalSession(_space(), seed=seeds[0])
+        try:
+            _drive_epochs(ref, epochs=3)
+            b = ref.best()
+        finally:
+            ref.close()
+        assert results[seeds[0]]["qor"] == b["qor"]
+        assert results[seeds[0]]["config"] == b["config"]
+        for seed in seeds[1:]:
+            s = LocalSession(_space(), seed=seed)
+            try:
+                _drive_epochs(s, epochs=3)
+                assert results[seed]["qor"] == s.best()["qor"], seed
+            finally:
+                s.close()
+
+
+class TestCrossTenantMemo:
+    def test_memo_serves_other_tenants_rows(self, server):
+        """Tenant A measures an epoch; tenant B (same space, same
+        program, same seed => same proposals) is served every row
+        from the memo: epoch commits with ZERO tells."""
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=42,
+                                program="memo-shared") as a:
+                _drive_epochs(a, epochs=1)
+                best_a = a.best()
+            with c.open_session(_space(), seed=42,
+                                program="memo-shared") as b:
+                trials = b.ask(4)
+                bb = b.best()
+                # epoch 1 auto-committed from the memo; any offers are
+                # epoch 2 (which nobody measured yet)
+                assert bb["version"] >= 1
+                assert bb["tells"] == 0
+                assert bb["store_served"] > 0
+                assert bb["qor"] == best_a["qor"]
+                assert bb["config"] == best_a["config"]
+                if trials:
+                    assert b.version >= 1
+
+    def test_program_token_scopes_the_memo(self, server):
+        """Same space + seed under a DIFFERENT program token shares
+        nothing: every row needs a build."""
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=42,
+                                program="memo-other") as d:
+                trials = d.ask(5)
+                assert len(trials) == 5
+                assert d.best()["store_served"] == 0
+
+
+class TestNoRetrace:
+    def test_join_leave_churn_traces_each_program_once(self):
+        """Strict trace-guard over a FRESH group's whole lifetime:
+        construction warmup, joins, interleaved epochs, leave, slot
+        reuse — three programs, each traced exactly once."""
+        from uptune_tpu.analysis.trace_guard import TraceGuard
+        with TraceGuard(limit=1, strict=True,
+                        name="serve-slot-programs") as tg:
+            g = SessionGroup(_space(), 2)
+            s1 = g.join(seed=1)
+            s2 = g.join(seed=2)
+            for t in s1.ask(3):
+                s1.tell(t.ticket, _measure(t.config))
+            for t in s2.ask(3):
+                s2.tell(t.ticket, _measure(t.config))
+            s1.close()
+            s3 = g.join(seed=3)     # slot reuse
+            for t in s3.ask(2):
+                s3.tell(t.ticket, _measure(t.config))
+            s3.close()
+            s2.close()
+        counts = {k: v for k, v in tg.counts.items() if "Engine" in k}
+        assert len(counts) == 3, counts
+        assert all(v == 1 for v in counts.values()), counts
+
+
+class TestBenchSmoke:
+    def test_serve_bench_quick_smoke(self, tmp_path):
+        """`bench.py --serve --quick` keeps producing its evidence
+        JSON: concurrent multiplexed sessions, both sequential
+        baselines, and a clean strict retrace report."""
+        env = {**os.environ, "UT_TRACE_GUARD": "strict",
+               "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--serve", "--quick", "--cpu"],
+            capture_output=True, text=True, env=env,
+            cwd=str(tmp_path), timeout=420)
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["metric"] == "serve_aggregate_asks_per_sec"
+        assert out["n_sessions"] >= 64
+        assert out["commits"] >= out["n_sessions"]
+        assert out["churn"]["opened"] > 0
+        assert out["retraces"]["excess"] == {}
+        assert out["baseline_cold_sequential"]["agg_asks_per_s"] > 0
+        assert os.path.exists(os.path.join(REPO,
+                                           "BENCH_SERVE.quick.json"))

@@ -103,6 +103,9 @@ class TestShardedScore:
 
 
 class TestMixedKernelShard:
+    @pytest.mark.slow   # suite-budget (ISSUE 8): sharded-vs-dense
+    # mixed-kernel parity; dense mixed-kernel coverage (test_surrogate)
+    # and the sharded lcb parity above stay tier-1
     def test_cat_split_matches_dense(self):
         """A mixed-kernel GPState must score identically sharded vs
         dense when the n_cont/n_cat split is passed through (r4 review

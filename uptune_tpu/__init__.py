@@ -39,6 +39,10 @@ _LAZY = {
     # batched multi-instance engine (engine/batched.py): N on-device
     # tunes of one space as ONE compiled vmapped program
     "tune_batch": ("uptune_tpu.api.batch", "tune_batch"),
+    # tuning-as-a-service (serve/, docs/SERVING.md): client for the
+    # `ut serve` multi-tenant session server, and the offline sibling
+    "connect": ("uptune_tpu.serve.client", "connect"),
+    "LocalSession": ("uptune_tpu.serve.session", "LocalSession"),
     # EDA report extractors (reference report.py:122-174)
     "vhls": ("uptune_tpu.api.features", "vhls"),
     "quartus": ("uptune_tpu.api.features", "quartus"),

@@ -68,6 +68,25 @@ DEFAULTS: Dict[str, Any] = {
     # between refits stays on in both modes — disable it via
     # surrogate_opts={'incremental': False})
     "surrogate-async": None,
+    # tuning-as-a-service session server (`ut serve`, docs/SERVING.md).
+    # Same precedence contract as every other key: CLI flags >
+    # ut.config(...) > these defaults.
+    # bind address / TCP port (0 = pick an ephemeral port and print it)
+    "serve-host": "127.0.0.1",
+    "serve-port": 8765,
+    # instance-slot capacity of each engine group: sessions sharing one
+    # space signature are packed onto one BatchedEngine instance axis
+    # (proposals batch ACROSS tenants); when a group fills, another
+    # group of the same signature is allocated
+    "serve-slots": 64,
+    # admission limit across all groups ('server full' above it)
+    "serve-max-sessions": 4096,
+    # shared cross-tenant results memo: one content-addressed store
+    # directory mounted under every session's scope — a config one
+    # tenant measured is served to any other tenant's ask without a
+    # build.  None = ut.serve/store under the server's cwd; 'off'
+    # disables the memo
+    "serve-store-dir": None,
 }
 
 settings: Dict[str, Any] = dict(DEFAULTS)

@@ -327,6 +327,21 @@ def _merge_replica_bests(cleaned: List[str], n: int,
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    raw = list(argv if argv is not None else sys.argv[1:])
+    if raw and raw[0] == "serve":
+        # `ut serve ...`: the tuning-as-a-service session server
+        # (docs/SERVING.md) has its own flag set and precedence layer
+        from .serve.cli import main as serve_main
+        return serve_main(raw[1:])
+    if raw and raw[0].startswith("-") and "serve" == next(
+            (a for a in raw if not a.startswith("-")), None):
+        # `ut -v serve` falls through and tries to TUNE a program
+        # file literally named "serve".  A hint only — never abort:
+        # "serve" here may legitimately be a flag VALUE (arity is the
+        # parser's business), and the tuning parser's own error
+        # follows if it really was a misplaced subcommand
+        print("[ut] hint: to start the session server, 'serve' must "
+              "come first: ut serve [flags]", file=sys.stderr)
     args = build_parser().parse_args(argv)
     _configure_logging(args.verbose)
     log = logging.getLogger("uptune_tpu")

@@ -434,10 +434,9 @@ class Tuner:
             donate_argnums=(0,) if donate else ())
 
     def _space_sig(self) -> List[str]:
-        """Ordered structural signature of the space: spec dataclass reprs
-        carry name, kind, bounds, options/items — any change invalidates
-        position-indexed unit-vector replay."""
-        return [repr(s) for s in self.space.specs]
+        """Ordered structural signature of the space (Space.signature):
+        any change invalidates position-indexed unit-vector replay."""
+        return self.space.signature()
 
     def _rotate_mismatch(self, path: str) -> None:
         import warnings

@@ -283,6 +283,81 @@ class BatchedEngine:
         return jax.lax.scan(
             body, state, jnp.arange(n_steps, dtype=jnp.int32))
 
+    # -- slot primitives (the serving plane's join/ask/tell seam) -----------
+    # A session server (uptune_tpu/serve, docs/SERVING.md) multiplexes
+    # many ask/tell tenants onto ONE stacked EngineState: proposal
+    # generation is vmapped across every slot in one dispatch, while
+    # join (init_slot), leave (slot reuse via init_slot) and tell
+    # (commit_slot) touch a single instance row.  All three programs
+    # take the slot index as a TRACED scalar and are memoized like
+    # jit_run, so a group compiles each exactly once — sessions joining
+    # and leaving NEVER retrace the batched program (the strict
+    # trace-guard contract BENCH_SERVE.json is held to).
+
+    def jit_propose_all(self):
+        """Jitted vmap(propose) over the stacked state ->
+        (stacked new tstates, stacked CandBatch [n, B, ...], stacked
+        keys).  Pure read of the state (nothing is donated): the state
+        advances only when a slot's measured batch commits, so one
+        proposal epoch can be re-derived — identically — for any slot
+        that has not moved since (propose is deterministic in the
+        state)."""
+        fn = self._compiled.get("propose_all")
+        if self.mesh is not None:
+            # a sharded group would put every tenant's ask on a
+            # cross-device dispatch; the serving plane scales by
+            # allocating more in-device groups instead
+            raise ValueError("slot primitives are unsharded-only")
+        if fn is None:
+            def _propose_all(s):
+                return jax.vmap(self.engine.propose)(s)
+            fn = self._compiled["propose_all"] = obs.instrument_device_fn(
+                jax.jit(_propose_all), "engine.propose_all",
+                n_instances=self.n_instances)
+        return fn
+
+    def jit_init_slot(self):
+        """Jitted (state, i, key) -> state with slot i re-initialized
+        from `key` — session join (and slot REUSE after a leave: the
+        departed tenant's rows are simply overwritten).  The stacked
+        state is donated and updated in place; `i` and `key` are traced,
+        so every join dispatches the same compiled program."""
+        fn = self._compiled.get("init_slot")
+        if self.mesh is not None:
+            raise ValueError("slot primitives are unsharded-only")
+        if fn is None:
+            def _init_slot(s, i, key):
+                fresh = _strong(self.engine.init(key))
+                return jax.tree.map(lambda a, b: a.at[i].set(b), s, fresh)
+            fn = self._compiled["init_slot"] = obs.instrument_device_fn(
+                jax.jit(_init_slot, donate_argnums=(0,)),
+                "engine.init_slot")
+        return fn
+
+    def jit_commit_slot(self):
+        """Jitted (state, tstates, cands, keys, raw, i) -> state with
+        slot i's pending proposal epoch committed: `tstates`/`cands`/
+        `keys` are jit_propose_all outputs (STACKED — the slot is
+        sliced inside the program, so the host never tree-maps per
+        leaf), `raw` is the [B] un-oriented measured QoR for slot i's
+        candidate rows.  The stacked state is donated; only row i
+        changes.  No exchange collective runs here: server sessions are
+        independent tenants, and cross-tenant coupling belongs to the
+        shared results store, not the engine state."""
+        fn = self._compiled.get("commit_slot")
+        if self.mesh is not None:
+            raise ValueError("slot primitives are unsharded-only")
+        if fn is None:
+            def _commit_slot(s, tstates, cands, keys, raw, i):
+                at = lambda t: jax.tree.map(lambda x: x[i], t)  # noqa: E731
+                new_i = self.engine.commit(
+                    at(s), at(tstates), at(cands), raw, keys[i])
+                return jax.tree.map(lambda a, b: a.at[i].set(b), s, new_i)
+            fn = self._compiled["commit_slot"] = obs.instrument_device_fn(
+                jax.jit(_commit_slot, donate_argnums=(0,)),
+                "engine.commit_slot")
+        return fn
+
     # -- host-side results --------------------------------------------------
     def best_qors(self, state: EngineState) -> np.ndarray:
         """[n_instances] per-instance best QoR in USER orientation

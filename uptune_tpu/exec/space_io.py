@@ -62,6 +62,63 @@ def stage_spaces(all_records: Sequence[Sequence[Dict[str, Any]]]
     return [space_from_params(stage) for stage in all_records]
 
 
+def records_from_space(space: Space) -> List[Dict[str, Any]]:
+    """The inverse bridge: serialize a library `Space` back into the
+    JSON param records `space_from_params` consumes.  The session
+    client (uptune_tpu/serve) sends these over the wire so a server
+    rebuilds an identical Space — identical `Space.signature()`, so two
+    tenants opening from the same Space land in the same engine group.
+    Only JSON-representable option/item values survive the round trip
+    (the wire format is JSON); ScheduleParam dependencies do not cross
+    the wire."""
+    out: List[Dict[str, Any]] = []
+    for s in space.specs:
+        if isinstance(s, P.ScheduleParam):
+            raise ValueError(
+                f"ScheduleParam {s.name!r} is not wire-serializable")
+        if isinstance(s, P.PermParam):
+            out.append({"name": s.name, "type": "perm",
+                        "items": [list(o) if isinstance(o, tuple) else o
+                                  for o in s.items]})
+        elif isinstance(s, P.SelectorParam):
+            out.append({"name": s.name, "type": "selector",
+                        "choices": list(s.choices),
+                        "max_cutoff": s.max_cutoff})
+        elif isinstance(s, P.EnumParam):
+            out.append({"name": s.name, "type": "enum",
+                        "options": [list(o) if isinstance(o, tuple) else o
+                                    for o in s.options]})
+        elif isinstance(s, P.BoolArrayParam):
+            out.append({"name": s.name, "type": "bool_array", "n": s.n})
+        elif isinstance(s, P.IntArrayParam):
+            out.append({"name": s.name, "type": "int_array", "n": s.n,
+                        "lo": s.lo, "hi": s.hi})
+        elif isinstance(s, P.FloatArrayParam):
+            out.append({"name": s.name, "type": "float_array", "n": s.n,
+                        "lo": s.lo, "hi": s.hi})
+        elif isinstance(s, P.BoolParam):
+            out.append({"name": s.name, "type": "bool"})
+        elif isinstance(s, (P.LogIntParam,)):
+            out.append({"name": s.name, "type": "log_int",
+                        "lo": s.lo, "hi": s.hi})
+        elif isinstance(s, (P.LogFloatParam,)):
+            out.append({"name": s.name, "type": "log_float",
+                        "lo": s.lo, "hi": s.hi})
+        elif isinstance(s, P.Pow2Param):
+            out.append({"name": s.name, "type": "pow2",
+                        "lo": s.lo, "hi": s.hi})
+        elif isinstance(s, P.IntParam):
+            out.append({"name": s.name, "type": "int",
+                        "lo": s.lo, "hi": s.hi})
+        elif isinstance(s, P.FloatParam):
+            out.append({"name": s.name, "type": "float",
+                        "lo": s.lo, "hi": s.hi})
+        else:
+            raise ValueError(
+                f"no wire form for param {s.name!r} ({type(s).__name__})")
+    return out
+
+
 def default_config(records: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     """The program's declared defaults as a config dict (the seed trial —
     the reference captures its QoR in the profiling run)."""

@@ -3,9 +3,10 @@
 The scrape-able half of the observability plane: where spans answer
 "what happened when", metrics answer "how much, in aggregate" — store
 hit rates, worker utilization, prefetch queue depth, snapshot
-version/refit lag, dedup collisions, engine acquisition rates.  A
-future session server (ROADMAP item 1) exposes `snapshot()` as its
-scrape endpoint; today `uptune_tpu.obs.export` writes it as one JSONL
+version/refit lag, dedup collisions, engine acquisition rates.  The
+session server (uptune_tpu/serve, ROADMAP item 1) serves `snapshot()`
+as its ``{"op": "metrics"}`` scrape payload — the seam this module
+was written for; `uptune_tpu.obs.export` also writes it as one JSONL
 line per run and folds it into the text summary.
 
 Same contract as the span core: every update checks the core's

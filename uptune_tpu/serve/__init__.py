@@ -1,0 +1,32 @@
+"""Tuning-as-a-service: a multi-tenant session server (docs/SERVING.md).
+
+The reference shipped result *transport* (ZMQ result pipes, S3 archive
+push — PAPER.md L1/L5) but never a serving plane: every tune is a
+process.  This package is the serving plane — ONE persistent process
+multiplexing thousands of concurrent ask/tell tuning sessions onto the
+batched engine:
+
+* **Sessions are versioned snapshots** (the PR 5 pattern): ``ask``
+  hands out tickets against the session's current published state
+  version; ``tell`` fills the measured batch, and the commit that
+  completes it publishes the next version.  Stale tickets are rejected,
+  never silently merged.
+* **Proposal generation batches ACROSS tenants**: sessions whose spaces
+  share one structural signature are packed onto one
+  ``BatchedEngine`` instance axis, so one vmapped dispatch proposes for
+  every needy tenant at once (same compiled program; join/leave is
+  instance-slot allocation over donate-in-place stacked state and never
+  retraces — engine/batched.py slot primitives).
+* **The store is a shared cross-tenant memo**: every session scope
+  mounts the content-addressed result store, so a configuration one
+  tenant measured is served to any other tenant's ask without a build.
+
+Surface: ``ut serve`` (CLI), ``uptune_tpu.connect()`` -> SessionClient
+(wire protocol: newline-delimited JSON over TCP), and ``LocalSession``
+— the same session mechanics without a server, which doubles as the
+matched-seed offline sibling the parity tests hold the server to.
+"""
+from .client import SessionClient, SessionHandle, ServeError, Trial, connect  # noqa: F401
+from .group import SessionGroup, group_key  # noqa: F401
+from .session import LocalSession, Session, StaleTicketError  # noqa: F401
+from .server import SessionServer  # noqa: F401

@@ -1,0 +1,115 @@
+"""`ut serve` — run the session server from the command line.
+
+    ut serve                          # serve-host:serve-port defaults
+    ut serve --port 0                 # ephemeral port (printed)
+    ut serve --slots 256 --store-dir /shared/ut-store
+    ut serve --trace serve_trace.json # obs plane export on shutdown
+
+Flag precedence is the repo-wide contract: CLI flags > ut.config
+(`serve-*` keys) > DEFAULTS (api/session.py) — tested in
+tests/test_serve.py next to the store/trace key tests.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from typing import List, Optional
+
+log = logging.getLogger("uptune_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ut serve",
+        description="uptune-tpu multi-tenant tuning session server "
+                    "(docs/SERVING.md)")
+    p.add_argument("--host", default=None,
+                   help="bind address (default: ut.config serve-host)")
+    p.add_argument("--port", type=int, default=None,
+                   help="TCP port; 0 picks an ephemeral port "
+                        "(default: ut.config serve-port)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="instance-slot capacity per engine group: "
+                        "sessions sharing a space signature batch "
+                        "their proposal generation across one "
+                        "BatchedEngine instance axis of this width "
+                        "(default: ut.config serve-slots)")
+    p.add_argument("--max-sessions", type=int, default=None,
+                   help="admission limit across all groups "
+                        "(default: ut.config serve-max-sessions)")
+    p.add_argument("--store-dir", default=None, metavar="DIR",
+                   help="shared cross-tenant result memo directory; "
+                        "'off' disables (default: ut.config "
+                        "serve-store-dir, else ut.serve/store under "
+                        "the cwd)")
+    p.add_argument("--work-dir", default=None,
+                   help="base dir for the default store location")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="observability export written at shutdown "
+                        "(docs/OBSERVABILITY.md); 'off' disables")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+def resolve_config(args: argparse.Namespace) -> dict:
+    """Flags > ut.config serve-* keys > DEFAULTS, resolved into the
+    SessionServer constructor kwargs (None = let the constructor read
+    the settings layer; the indirection exists so the precedence is
+    testable without binding a socket)."""
+    from ..api.session import settings
+    out = {}
+    for flag, key in (("host", "serve-host"), ("port", "serve-port"),
+                      ("slots", "serve-slots"),
+                      ("max_sessions", "serve-max-sessions"),
+                      ("store_dir", "serve-store-dir")):
+        v = getattr(args, flag)
+        out[flag] = settings[key] if v is None else v
+    out["work_dir"] = args.work_dir
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="[%(relativeCreated)7.0fms] %(levelname)s %(message)s")
+
+    # the proposal engine is cheap next to tenant builds; like the
+    # tuning CLI, default to the hang-proof host platform
+    from ..utils.platform_guard import force_cpu
+    force_cpu(1)
+
+    from .. import obs
+    trace_path = args.trace
+    if trace_path is None:
+        trace_path = obs.maybe_enable_from_env()
+        if trace_path is None and not obs.enabled():
+            from ..api.session import settings
+            cfg_trace = settings["trace"]
+            if cfg_trace and str(cfg_trace).lower() not in ("off",
+                                                            "none"):
+                trace_path = str(cfg_trace)
+    elif trace_path.lower() in ("off", "none"):
+        trace_path = None
+    if trace_path and not obs.enabled():
+        obs.enable()
+
+    from .server import SessionServer
+    srv = SessionServer(**resolve_config(args))
+    try:
+        srv.serve_forever()
+    finally:
+        if trace_path:
+            obs.finish(trace_path)
+            log.info("[ut-serve] trace written to %s", trace_path)
+        elif obs.enabled():
+            snap = obs.metrics_snapshot()
+            log.info("[ut-serve] final metrics: %s",
+                     json.dumps(snap.get("counters", {})))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

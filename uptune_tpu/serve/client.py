@@ -1,0 +1,197 @@
+"""Python client for the session server: ``ut.connect()``.
+
+    import uptune_tpu as ut
+    from uptune_tpu.workloads import rosenbrock_space
+
+    client = ut.connect("127.0.0.1:8765")
+    s = client.open_session(rosenbrock_space(2, -3, 3), seed=7,
+                            program="rosen-demo")
+    for _ in range(200):
+        for t in s.ask(4):
+            s.tell(t.ticket, measure(t.config))
+    print(s.best())
+    s.close(); client.close()
+
+One ``SessionClient`` is one TCP connection; it may multiplex ANY
+number of sessions (requests are synchronous per connection and
+serialized by an internal lock — open several clients for parallel
+request streams).  Spaces are sent as JSON param records; a library
+``Space`` is serialized via ``exec.space_io.records_from_space``.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Union
+
+
+class ServeError(RuntimeError):
+    """The server answered ok=False."""
+
+
+class Trial(NamedTuple):
+    """One proposed trial: measure `config`, tell `ticket`."""
+    ticket: int
+    config: Dict[str, Any]
+
+
+def _parse_addr(addr: Union[str, tuple, None]) -> tuple:
+    from ..api.session import settings
+    if addr is None:
+        return (str(settings["serve-host"]), int(settings["serve-port"]))
+    if isinstance(addr, (tuple, list)):
+        return (str(addr[0]), int(addr[1]))
+    host, _, port = str(addr).rpartition(":")
+    if not host:
+        raise ValueError(f"address must be 'host:port', got {addr!r}")
+    return (host, int(port))
+
+
+def connect(addr: Union[str, tuple, None] = None,
+            timeout: float = 60.0) -> "SessionClient":
+    """Open a client connection (`addr` = "host:port", a (host, port)
+    pair, or None for the configured serve-host/serve-port)."""
+    return SessionClient(*_parse_addr(addr), timeout=timeout)
+
+
+class SessionClient:
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.host, self.port = host, int(port)
+        self._sock = socket.create_connection((host, self.port),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._f = self._sock.makefile("rwb")
+        self._lock = threading.Lock()
+        self._broken = False
+
+    # -- wire ----------------------------------------------------------
+    def request(self, op: str, **fields: Any) -> Dict[str, Any]:
+        """One synchronous request/response; raises ServeError on
+        ok=False."""
+        payload = {"op": op, **{k: v for k, v in fields.items()
+                                if v is not None}}
+        with self._lock:
+            # a request that died mid-exchange (socket timeout,
+            # KeyboardInterrupt out of readline) leaves its response
+            # in flight; the NEXT request would silently consume it
+            # as its own.  The connection is desynced — refuse it.
+            if self._broken:
+                raise ServeError(
+                    "connection desynced by an interrupted request; "
+                    "reconnect")
+            try:
+                self._f.write(json.dumps(payload,
+                                         separators=(",", ":"))
+                              .encode() + b"\n")
+                self._f.flush()
+                line = self._f.readline()
+            except BaseException:
+                self._broken = True
+                raise
+        if not line:
+            raise ServeError(f"server {self.host}:{self.port} closed "
+                             f"the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise ServeError(resp.get("error", "unknown server error"))
+        return resp
+
+    # -- surface -------------------------------------------------------
+    def ping(self) -> Dict[str, Any]:
+        return self.request("ping")
+
+    def metrics(self) -> Dict[str, Any]:
+        """The server's obs metrics scrape (counters / gauges /
+        histogram summaries — docs/OBSERVABILITY.md names)."""
+        return self.request("metrics")
+
+    def stats(self) -> Dict[str, Any]:
+        return self.request("stats")
+
+    def open_session(self, space: Any, *, seed: int = 0,
+                     program: str = "",
+                     sense: str = "min",
+                     arms: Optional[Sequence[str]] = None,
+                     history_capacity: int = 1 << 10,
+                     store: bool = True) -> "SessionHandle":
+        """Open one tuning session.  `space` is a library Space or a
+        list of JSON param records; `program` is the tenant-declared
+        token naming WHAT is being measured — sessions naming the same
+        program over the same space share the server's cross-tenant
+        result memo."""
+        if not isinstance(space, (list, tuple)):
+            from ..exec.space_io import records_from_space
+            space = records_from_space(space)
+        resp = self.request(
+            "open", space=list(space), seed=int(seed),
+            program=str(program), sense=sense,
+            arms=list(arms) if arms else None,
+            history_capacity=int(history_capacity),
+            store="on" if store else "off")
+        return SessionHandle(self, resp["session"], resp)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "SessionClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class SessionHandle:
+    """One session on one client: ask / tell / best / close."""
+
+    def __init__(self, client: SessionClient, session_id: str,
+                 info: Optional[dict] = None):
+        self.client = client
+        self.id = session_id
+        self.info = dict(info or {})
+        self.version = 0
+        self.store_served = 0
+
+    def ask(self, n: int = 1) -> List[Trial]:
+        resp = self.client.request("ask", session=self.id, n=int(n))
+        self.version = resp.get("version", self.version)
+        self.store_served = resp.get("store_served", self.store_served)
+        return [Trial(t["ticket"], t["config"])
+                for t in resp["trials"]]
+
+    def tell(self, ticket: int, qor: Optional[float],
+             dur: float = 0.0) -> Dict[str, Any]:
+        resp = self.client.request("tell", session=self.id,
+                                   ticket=int(ticket), qor=qor,
+                                   dur=dur or None)
+        self.version = resp.get("version", self.version)
+        return resp
+
+    def tell_many(self, results) -> Dict[str, Any]:
+        """Report many (ticket, qor) pairs in ONE round trip."""
+        resp = self.client.request(
+            "tell", session=self.id,
+            results=[{"ticket": int(t), "qor": q} for t, q in results])
+        self.version = resp.get("version", self.version)
+        return resp
+
+    def best(self) -> Dict[str, Any]:
+        return self.client.request("best", session=self.id)
+
+    def close(self) -> None:
+        try:
+            self.client.request("close", session=self.id)
+        except (ServeError, OSError):
+            # already closed server-side, or the connection is gone —
+            # the server reaps dead connections' sessions anyway
+            pass
+
+    def __enter__(self) -> "SessionHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,220 @@
+"""Engine groups: many tenants, one batched program.
+
+A ``SessionGroup`` owns one ``BatchedEngine`` whose instance axis is a
+pool of *slots*.  Each tenant session occupies one slot; everything a
+session does maps onto exactly three compiled programs, each traced
+once for the group's lifetime (the strict trace-guard contract):
+
+* join / slot reuse -> ``jit_init_slot``   (donated, dynamic index)
+* need proposals    -> ``jit_propose_all`` (ONE vmapped dispatch for
+  every slot — the cross-tenant batching this plane exists for)
+* batch measured    -> ``jit_commit_slot`` (donated, dynamic index)
+
+Proposal epochs exploit that ``propose`` is pure in the state: an
+epoch taken now is valid for every slot that has not committed since,
+so one dispatch refreshes every needy tenant (``pending_for``
+coalesces), and a mid-flight tenant keeps its older epoch — the
+stacked arrays it will commit against stay alive by reference.
+
+All group state is guarded by one reentrant lock per group.  The
+propose is ENQUEUED under that lock but never awaited there: JAX
+dispatch is asynchronous, so the lock covers microseconds of argument
+processing while the vmapped compute runs on the runtime's own
+threads — the blocking device->host read happens later, in
+``ProposalEpoch.host_rows``, outside the lock.  Enqueueing under the
+lock is also what makes the donation discipline sound: commit_slot
+and init_slot DONATE the stacked state, and they take the same lock,
+so a propose's input buffers can never be invalidated between
+snapshotting the state and dispatching on it (once both are enqueued,
+the runtime sequences the in-flight read before the donated write).
+A commit landing after the propose only makes the published epoch
+stale for THAT slot — its generation moved — which triggers the next
+refresh.
+
+The three slot programs are traced + compiled at GROUP CONSTRUCTION
+(one warmup propose/commit/init round on placeholder slot 0): a
+serving group pays compile at onboarding, never inside a tenant's ask
+— BENCH_SERVE's single-digit-ms ask p95 depends on it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..engine import BatchedEngine, FusedEngine
+from ..space.spec import CandBatch, Space
+
+
+def group_key(space: Space, arms: Optional[Sequence[str]],
+              sense: str, history_capacity: int) -> Tuple:
+    """Tenant-grouping identity: sessions are multiplexed onto one
+    batched program only when EVERYTHING that shapes its avals and
+    semantics matches — structural space signature, arm portfolio,
+    orientation, and dedup-history capacity."""
+    return (tuple(space.signature()),
+            tuple(arms) if arms else "default",
+            sense, int(history_capacity))
+
+
+class ProposalEpoch:
+    """One jit_propose_all output: stacked technique states, stacked
+    candidates, stacked keys, plus the per-slot state generation at
+    take time (validity check) and a lazily materialized host copy of
+    the candidate rows (ONE device->host transfer per epoch; per-slot
+    reads are numpy views)."""
+
+    __slots__ = ("tstates", "cands", "keys", "slot_gens", "_host")
+
+    def __init__(self, tstates, cands: CandBatch, keys,
+                 slot_gens: Tuple[int, ...]):
+        self.tstates = tstates
+        self.cands = cands
+        self.keys = keys
+        self.slot_gens = slot_gens
+        self._host = None
+
+    def host_rows(self, slot: int) -> CandBatch:
+        """Slot `slot`'s candidate batch as host numpy (for config
+        decode); the stacked pull happens once per epoch.  Called
+        WITHOUT the group lock (session decode runs unlocked), so the
+        lazy materialization is one atomic tuple rebind — a racing
+        duplicate pull is benign (identical values, last ref wins)."""
+        h = self._host
+        if h is None:
+            h = (np.asarray(self.cands.u),
+                 tuple(np.asarray(p) for p in self.cands.perms))
+            self._host = h
+        u, perms = h
+        return CandBatch(u[slot], tuple(p[slot] for p in perms))
+
+
+class SessionGroup:
+    """One space signature's slice of the serving plane: a slot pool
+    over a BatchedEngine plus the shared proposal-epoch cache."""
+
+    def __init__(self, space: Space, slots: int, *,
+                 arms: Optional[Sequence[str]] = None,
+                 sense: str = "min", history_capacity: int = 1 << 10):
+        self.space = space
+        self.sense = sense
+        self.key = group_key(space, arms, sense, history_capacity)
+        # objective=None: evaluation is the TENANT's side of the
+        # protocol — only the propose/commit halves ever run here, and
+        # commit takes the measured raw batch directly
+        self.engine = FusedEngine(space, None, arms=list(arms) if arms
+                                  else None, sense=sense,
+                                  history_capacity=history_capacity)
+        self.batched = BatchedEngine(self.engine, slots)
+        self.n_slots = int(slots)
+        self.batch = self.engine.total_batch   # rows per epoch
+        self.lock = threading.RLock()
+        import jax
+        # slot 0..n-1 placeholder streams; every join re-seeds its slot
+        # from the tenant's own seed, so this key is inert — and a
+        # constant one keeps group construction deterministic
+        placeholder = jax.random.PRNGKey(0)
+        self.state = self.batched.init(placeholder)
+        self._jnp = jax.numpy
+        self.slot_gen = [0] * self.n_slots
+        self.free: List[int] = list(range(self.n_slots - 1, -1, -1))
+        self.active: Dict[int, Any] = {}   # slot -> Session
+        self.epoch: Optional[ProposalEpoch] = None
+        self._warm(placeholder)
+
+    def _warm(self, key) -> None:
+        """Trace + compile the group's three programs up front with one
+        throwaway propose/commit/init round on placeholder slot 0 (the
+        commit's NaN batch and the init key are inert: every join
+        re-seeds its slot before proposals are read).  Onboarding a new
+        group pays the compile wall here — visible in BENCH_SERVE's
+        open phase — so no tenant's ask ever does."""
+        import jax
+        with obs.span("serve.warm_compile", slots=self.n_slots):
+            t, c, k = self.batched.jit_propose_all()(self.state)
+            st = self.batched.jit_commit_slot()(
+                self.state, t, c, k,
+                self._jnp.full((self.batch,), self._jnp.nan,
+                               self._jnp.float32),
+                self._jnp.int32(0))
+            self.state = self.batched.jit_init_slot()(
+                st, self._jnp.int32(0), key)
+            jax.block_until_ready(self.state)
+
+    # -- membership ----------------------------------------------------
+    @property
+    def n_active(self) -> int:
+        return len(self.active)
+
+    @property
+    def n_free(self) -> int:
+        return len(self.free)
+
+    def join(self, seed: int, store=None, session_id: Optional[str] = None):
+        """Allocate a slot and seed it from the tenant's own PRNG
+        stream (slot-position independent: the same seed produces the
+        same session in ANY group — the matched-seed parity contract
+        with LocalSession).  Raises IndexError when full."""
+        from .session import Session
+        import jax
+        with self.lock:
+            slot = self.free.pop()
+            self.state = self.batched.jit_init_slot()(
+                self.state, self._jnp.int32(slot),
+                jax.random.PRNGKey(int(seed)))
+            self.slot_gen[slot] += 1
+            sess = Session(self, slot, int(seed), store=store,
+                           session_id=session_id)
+            self.active[slot] = sess
+            obs.count("serve.joins")
+            return sess
+
+    def leave(self, sess) -> None:
+        """Free the slot.  The departed tenant's state rows stay in the
+        stacked arrays until a future join overwrites them (init_slot);
+        proposals for free slots are dead rows nobody reads."""
+        with self.lock:
+            if self.active.get(sess.slot) is sess:
+                del self.active[sess.slot]
+                self.free.append(sess.slot)
+                obs.count("serve.leaves")
+
+    # -- the three device paths ----------------------------------------
+    def pending_for(self, sess) -> ProposalEpoch:
+        """An epoch valid for `sess`'s slot.  When the cached epoch
+        predates the slot's last commit, ONE vmapped dispatch refreshes
+        it — and with it every other needy tenant (coalescing: the
+        batch-fill gauge records how many sessions each dispatch
+        actually served).  The whole check-refresh-publish is one lock
+        hold (enqueue only — see the module docstring); the first
+        caller after a commit dispatches, everyone else reads the
+        published epoch."""
+        with self.lock:
+            ep = self.epoch
+            if ep is not None and \
+                    ep.slot_gens[sess.slot] == self.slot_gen[sess.slot]:
+                return ep
+            needy = sum(1 for s in self.active.values()
+                        if s.pending is None)
+            with obs.span("serve.propose", slots=self.n_slots):
+                t, c, k = self.batched.jit_propose_all()(self.state)
+            ep = ProposalEpoch(t, c, k, tuple(self.slot_gen))
+            self.epoch = ep
+            obs.count("serve.proposes")
+            obs.gauge("serve.batch_fill",
+                      needy / max(1, self.n_slots))
+            return ep
+
+    def commit(self, sess, epoch: ProposalEpoch,
+               raw: np.ndarray) -> None:
+        """Publish `sess`'s measured epoch: one donated dispatch
+        updating only its slot row of the stacked state."""
+        with obs.span("serve.commit", slot=sess.slot):
+            self.state = self.batched.jit_commit_slot()(
+                self.state, epoch.tstates, epoch.cands, epoch.keys,
+                self._jnp.asarray(raw, self._jnp.float32),
+                self._jnp.int32(sess.slot))
+        self.slot_gen[sess.slot] += 1
+        obs.count("serve.commits")

@@ -1,0 +1,490 @@
+"""The session server: newline-delimited JSON over TCP, thread per
+connection, every request one JSON object with an ``op`` field.
+
+    {"op": "open", "space": [...param records...], "seed": 3,
+     "program": "my-flow"}            -> {"ok": true, "session": "..."}
+    {"op": "ask", "session": s, "n": 4}
+    {"op": "tell", "session": s, "ticket": 0, "qor": 1.25}
+    {"op": "best", "session": s}
+    {"op": "close", "session": s}
+    {"op": "metrics"}                 -> the obs metrics scrape
+    {"op": "stats"} / {"op": "ping"}
+
+``SessionServer.handle(request) -> response`` is the transport-free
+dispatch (tests and the in-process bench drive it directly); the TCP
+layer is one reader/writer loop around it.  An optional ``id`` field
+is echoed verbatim so clients may pipeline.
+
+Tenant grouping happens at ``open``: the request's space records are
+rebuilt into a Space, and sessions whose ``group_key`` matches share
+one BatchedEngine instance axis (new groups are allocated when
+existing ones fill).  Scoped result stores — the cross-tenant memo —
+are shared per (space signature, program token) under one store
+directory, so one tenant's recorded build serves another's ask.
+
+There is no authentication or tenant quota beyond the session cap:
+this is an in-cluster serving plane, not an internet-facing one
+(docs/SERVING.md).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import obs
+from ..api.session import settings
+from ..exec.space_io import space_from_params
+from ..store.store import ResultStore
+from .group import SessionGroup, group_key
+from .session import Session, StaleTicketError
+
+log = logging.getLogger("uptune_tpu")
+
+
+class RequestError(ValueError):
+    """Bad request payload (reported to the client, never fatal)."""
+
+
+def _resolve(value, key):
+    """The documented precedence: explicit argument (CLI flag layer) >
+    ut.config session settings > DEFAULTS."""
+    return settings[key] if value is None else value
+
+
+class SessionServer:
+    """One serving process.  Construct, ``start()``, ``connect()``
+    clients against ``.port``, ``stop()``.  All constructor parameters
+    default through the ``serve-*`` ut.config keys."""
+
+    def __init__(self, host: Optional[str] = None,
+                 port: Optional[int] = None,
+                 slots: Optional[int] = None,
+                 max_sessions: Optional[int] = None,
+                 store_dir: Optional[str] = None,
+                 work_dir: Optional[str] = None):
+        self.host = str(_resolve(host, "serve-host"))
+        self.port = int(_resolve(port, "serve-port"))
+        self.slots = int(_resolve(slots, "serve-slots"))
+        self.max_sessions = int(_resolve(max_sessions,
+                                         "serve-max-sessions"))
+        if self.slots < 1:
+            raise ValueError(f"serve-slots must be >= 1: {self.slots}")
+        sd = _resolve(store_dir, "serve-store-dir")
+        self.work_dir = os.path.abspath(work_dir or os.getcwd())
+        if sd is None:
+            sd = os.path.join(self.work_dir, "ut.serve", "store")
+        self.store_dir = (None if str(sd).lower() in ("off", "none")
+                          else os.path.abspath(str(sd)))
+        self._lock = threading.RLock()      # registries only
+        self._groups: Dict[Tuple, List[SessionGroup]] = {}
+        self._glocks: Dict[Tuple, threading.Lock] = {}
+        self._admitted = 0      # admission reservations (<= max)
+        self._sessions: Dict[str, Session] = {}
+        self._stores: Dict[Tuple, ResultStore] = {}
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._running = False
+        self.started_unix = time.time()
+        # the metrics registry only records while the obs plane is
+        # enabled; a serving process keeps it on so the scrape op (and
+        # BENCH_SERVE's evidence) always has data.  Span rings are
+        # bounded per thread, so long-lived servers don't grow
+        if not obs.enabled():
+            obs.enable()
+
+    # -- registry ------------------------------------------------------
+    def _store_for(self, space, program: str) -> Optional[ResultStore]:
+        if self.store_dir is None:
+            return None
+        sig = space.signature()
+        key = (tuple(sig), str(program))
+        with self._lock:
+            st = self._stores.get(key)
+        if st is not None:
+            return st
+        # construct OUTSIDE the registry lock (the initial base/seg
+        # disk scan can be large — the _join_group rule: a new
+        # tenant's construction wall must not stall every other op),
+        # double-checked insert under it.  The eval signature is the
+        # tenant-declared program token: tenants naming the same
+        # program (and space) share rows; different tokens never
+        # collide.  A losing racer's instance never touched disk
+        # (the segment opens lazily on first append) — just close it.
+        new = ResultStore(self.store_dir, sig,
+                          ["ut-serve", str(program)])
+        with self._lock:
+            st = self._stores.get(key)
+            if st is None:
+                self._stores[key] = st = new
+        if st is not new:
+            new.close()
+        return st
+
+    def _join_group(self, space, arms, sense: str,
+                    history_capacity: int, seed: int, store) -> Session:
+        """Join a free slot in an existing group for this key, or
+        construct a new group and join it.  Group construction traces
+        and compiles three programs (seconds) — it runs under a PER-KEY
+        construction lock, never the registry lock, so a new tenant's
+        compile wall stalls only same-key joiners, not the rest of the
+        serving plane."""
+        key = group_key(space, arms, sense, history_capacity)
+        with self._lock:
+            klock = self._glocks.setdefault(key, threading.Lock())
+        while True:
+            with self._lock:
+                frees = [g for g in self._groups.setdefault(key, [])
+                         if g.n_free]
+            for g in frees:
+                try:
+                    return g.join(seed, store=store)
+                except IndexError:
+                    continue    # lost the last slot to a racing join
+            with klock:
+                with self._lock:
+                    if any(g.n_free for g in self._groups[key]):
+                        continue    # a slot freed while we waited
+                g = SessionGroup(space, self.slots, arms=arms,
+                                 sense=sense,
+                                 history_capacity=history_capacity)
+                with self._lock:
+                    self._groups[key].append(g)
+                obs.count("serve.groups_created")
+
+    def _session(self, req: dict) -> Session:
+        sid = req.get("session")
+        sess = self._sessions.get(sid)
+        if sess is None:
+            raise RequestError(f"unknown session {sid!r}")
+        return sess
+
+    @property
+    def n_sessions(self) -> int:
+        return len(self._sessions)
+
+    # -- ops -----------------------------------------------------------
+    def _op_ping(self, req: dict) -> dict:
+        return {"t": time.time(), "sessions": self.n_sessions}
+
+    def _op_open(self, req: dict) -> dict:
+        records = req.get("space")
+        if not isinstance(records, list) or not records:
+            raise RequestError("open needs 'space': a non-empty list "
+                               "of param records")
+        try:
+            space = space_from_params(records)
+        except (KeyError, TypeError, ValueError) as e:
+            raise RequestError(f"bad space records: {e}")
+        sense = req.get("sense", "min")
+        if sense not in ("min", "max"):
+            raise RequestError(f"sense must be min|max: {sense!r}")
+        arms = req.get("arms")
+        if arms is not None and not (
+                isinstance(arms, list)
+                and all(isinstance(a, str) for a in arms)):
+            raise RequestError("arms must be a list of technique names")
+        try:
+            hist = int(req.get("history_capacity", 1 << 10))
+            seed = int(req.get("seed", 0))
+        except (TypeError, ValueError) as e:
+            raise RequestError(
+                f"history_capacity/seed must be integers: {e}")
+        program = str(req.get("program", ""))
+        use_store = str(req.get("store", "on")).lower() not in (
+            "off", "false", "0")
+        # admission is a reserve-then-join two-step so the (possibly
+        # compiling) join runs outside the registry lock without
+        # letting racing opens overshoot max_sessions
+        with self._lock:
+            if self._admitted >= self.max_sessions:
+                raise RequestError(
+                    f"server full ({self.max_sessions} sessions)")
+            self._admitted += 1
+        try:
+            store = (self._store_for(space, program) if use_store
+                     else None)
+            try:
+                sess = self._join_group(space, arms, sense, hist,
+                                        seed, store)
+            except ValueError as e:     # e.g. no arm supports space
+                raise RequestError(str(e))
+            with self._lock:
+                self._sessions[sess.id] = sess
+                obs.gauge("serve.sessions.active", self.n_sessions)
+        except BaseException:
+            with self._lock:
+                self._admitted -= 1
+            raise
+        grp = sess.group
+        return {"session": sess.id, "slots": grp.n_slots,
+                "batch": grp.batch, "store": store is not None}
+
+    def _op_ask(self, req: dict) -> dict:
+        sess = self._session(req)
+        try:
+            n = int(req.get("n", 1))
+        except (TypeError, ValueError) as e:
+            raise RequestError(f"n must be an integer: {e}")
+        t0 = time.perf_counter()
+        try:
+            offers = sess.ask(n)
+        except StaleTicketError as e:
+            # a concurrent close between the registry fetch and the
+            # ask is a routine client-side race, not a server fault
+            raise RequestError(str(e))
+        obs.observe("serve.ask_ms", (time.perf_counter() - t0) * 1e3)
+        return {"trials": [{"ticket": o.ticket, "config": o.config}
+                           for o in offers],
+                "version": sess.version,
+                "store_served": sess.store_served}
+
+    def _op_tell(self, req: dict) -> dict:
+        """Single tell (`ticket` + `qor`) or a batch in one round trip
+        (`results`: list of {ticket, qor[, dur]} objects) — a tenant
+        measuring trials in parallel reports them all at once."""
+        sess = self._session(req)
+        is_batch = "results" in req
+        if is_batch:
+            batch = req["results"]
+            if not isinstance(batch, list):
+                raise RequestError("'results' must be a list")
+        elif "ticket" in req:
+            batch = [req]
+        else:
+            raise RequestError("tell needs 'ticket' or 'results'")
+        t0 = time.perf_counter()
+        out: Dict[str, Any] = {"told": 0, "new_best": False,
+                               "committed": False}
+        # a batch applies element-wise: one bad/stale ticket must not
+        # discard the progress of the others (they are already told
+        # server-side — reporting ok=False would strand the epoch).
+        # Per-element failures come back in `errors`; a SINGLE tell
+        # keeps the hard ok=False contract.
+        errors: List[Dict[str, Any]] = []
+        for r in batch:
+            try:
+                one = sess.tell(int(r["ticket"]), r.get("qor"),
+                                float(r.get("dur", 0.0)))
+            except StaleTicketError as e:
+                if not is_batch:
+                    raise RequestError(str(e))
+                errors.append({"ticket": r.get("ticket"),
+                               "error": str(e)})
+                continue
+            except (KeyError, TypeError, ValueError,
+                    AttributeError) as e:
+                if not is_batch:
+                    raise RequestError(f"bad tell payload: {e}")
+                errors.append({"ticket": (r.get("ticket")
+                                          if isinstance(r, dict)
+                                          else None),
+                               "error": f"bad tell payload: {e}"})
+                continue
+            out["told"] += 1
+            out["new_best"] = out["new_best"] or one["new_best"]
+            out["committed"] = out["committed"] or one["committed"]
+            out["version"] = one["version"]
+        if errors:
+            out["errors"] = errors
+        obs.observe("serve.tell_ms", (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _op_best(self, req: dict) -> dict:
+        return self._session(req).best()
+
+    def _op_close(self, req: dict) -> dict:
+        sess = self._session(req)
+        sess.close()
+        with self._lock:
+            if self._sessions.pop(sess.id, None) is not None:
+                self._admitted -= 1
+            obs.gauge("serve.sessions.active", self.n_sessions)
+        return {"closed": sess.id}
+
+    def _op_metrics(self, req: dict) -> dict:
+        """The obs-plane scrape (PR 7 left this seam open: metrics
+        snapshot() was written as the future session-server payload)."""
+        return {"metrics": obs.metrics_snapshot(),
+                "sessions": self.n_sessions,
+                "uptime_s": round(time.time() - self.started_unix, 3)}
+
+    def _op_stats(self, req: dict) -> dict:
+        with self._lock:
+            groups = [{"space": g.key[0][0][:60] if g.key[0] else "",
+                       "slots": g.n_slots, "active": g.n_active,
+                       "batch": g.batch}
+                      for gs in self._groups.values() for g in gs]
+            # keyed program@scope-prefix: two stores sharing a program
+            # token over DIFFERENT spaces must not overwrite each
+            # other in the payload (scope hashes space sig + program)
+            stores = {f"{k[1] or '<anon>'}@{s.scope[:10]}": s.stats()
+                      for k, s in self._stores.items()}
+        return {"sessions": self.n_sessions, "groups": groups,
+                "stores": stores, "store_dir": self.store_dir}
+
+    _OPS = {"ping": _op_ping, "open": _op_open, "ask": _op_ask,
+            "tell": _op_tell, "best": _op_best, "close": _op_close,
+            "metrics": _op_metrics, "stats": _op_stats}
+
+    def handle(self, req: Any) -> dict:
+        """Transport-free dispatch: one request dict -> one response
+        dict (never raises; errors come back as ok=False)."""
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be a JSON "
+                                          "object"}
+        rid = req.get("id")
+        op = req.get("op")
+        # an unhashable op (list/dict) must hit the unknown-op reply,
+        # not TypeError out of the dict lookup before the error wall
+        fn = self._OPS.get(op) if isinstance(op, str) else None
+        if fn is None:
+            out = {"ok": False,
+                   "error": f"unknown op {op!r}; valid: "
+                            f"{sorted(self._OPS)}"}
+        else:
+            try:
+                out = {"ok": True, **fn(self, req)}
+            except RequestError as e:
+                out = {"ok": False, "error": str(e)}
+            except Exception as e:   # defensive: a tenant must not
+                # be able to take the serving loop down
+                log.exception("[ut-serve] %s failed", op)
+                out = {"ok": False,
+                       "error": f"internal: {type(e).__name__}: {e}"}
+        if rid is not None:
+            out["id"] = rid
+        return out
+
+    # -- TCP -----------------------------------------------------------
+    def start(self) -> "SessionServer":
+        """Bind + listen + accept loop in a daemon thread; .port holds
+        the bound port (useful with port=0)."""
+        # a serving process trades a little throughput for tail
+        # latency: the interpreter's default 5ms GIL switch interval
+        # parks every waiting request behind CPU-bound peers (config
+        # decode, JSON, a tenant thread's own measurement loop) in
+        # 5ms quanta — milliseconds of queueing on a sub-ms op.
+        # BENCH_SERVE's ask p95 is measured under this setting
+        if sys.getswitchinterval() > 0.001:
+            sys.setswitchinterval(0.0005)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(128)
+        self.port = s.getsockname()[1]
+        self._listener = s
+        self._running = True
+        t = threading.Thread(target=self._accept_loop,
+                             name="ut-serve-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("[ut-serve] listening on %s:%d (slots=%d, "
+                 "max-sessions=%d, store=%s)", self.host, self.port,
+                 self.slots, self.max_sessions,
+                 self.store_dir or "off")
+        return self
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return      # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            # daemon threads are not tracked: _serve_conn prunes its
+            # own conn on exit, so a long-lived server's registries
+            # stay bounded by LIVE connections under open/close churn
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(conn, addr),
+                                 name=f"ut-serve-{addr[1]}",
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        f = conn.makefile("rwb")
+        # session lifetime is CONNECTION-scoped: ids opened here are
+        # reaped when the connection dies, so a crashed tenant cannot
+        # hold its group slot and admission unit forever (a long-lived
+        # server would otherwise leak to "server full" under client
+        # churn).  Tracked at the transport layer — handle() stays
+        # transport-free and in-process sessions are unaffected.
+        owned: set = set()
+        try:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError as e:
+                    resp = {"ok": False, "error": f"bad JSON: {e}"}
+                else:
+                    resp = self.handle(req)
+                    if resp.get("ok") and isinstance(req, dict):
+                        if req.get("op") == "open":
+                            owned.add(resp["session"])
+                        elif req.get("op") == "close":
+                            owned.discard(resp.get("closed"))
+                f.write(json.dumps(resp, separators=(",", ":"))
+                        .encode() + b"\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass            # client went away mid-write
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass    # stop() already swept it
+            for sid in owned:   # best-effort: never raises
+                self.handle({"op": "close", "session": sid})
+
+    def stop(self) -> None:
+        self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        # snapshot under _lock: handler threads may still be mutating
+        # both registries (an open inside _store_for, an accept racing
+        # the _running flip) while shutdown walks them
+        with self._lock:
+            conns = list(self._conns)
+            stores = list(self._stores.values())
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        for st in stores:
+            st.close()
+
+    def serve_forever(self) -> None:
+        """start() + block until KeyboardInterrupt (the CLI path)."""
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            log.info("[ut-serve] shutting down")
+        finally:
+            self.stop()
+
+    def __enter__(self) -> "SessionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,350 @@
+"""One tenant's session: tickets over versioned snapshots.
+
+A session is a state machine over its group slot:
+
+    version v ──ask──> pending epoch (B candidate rows, decoded once)
+        │                 │ tickets: one per UNIQUE config (in-epoch
+        │                 │ duplicate rows share a ticket and a value)
+        │                 │ store memo: rows another tenant already
+        │                 │ measured are auto-filled — no ticket at all
+        │<───commit────── │ every row filled -> publish version v+1
+
+``ask`` returns tickets against the CURRENT version; ``tell`` fills
+rows; the tell that completes the batch commits (one donated dispatch)
+and publishes the next version.  A ticket from a published-over epoch
+is stale and rejected (StaleTicketError) — the versioned-snapshot
+contract of the PR 5 surrogate plane, applied to tenants.
+
+``LocalSession`` is the same machinery on a private single-slot group:
+the *offline tuner* of the serving plane.  The parity tests (and the
+bench's sequential baseline) hold the multiplexed server to bitwise
+per-session equality with it at matched seeds.
+"""
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from .. import obs
+from ..store.keys import canon_config
+
+
+class StaleTicketError(KeyError):
+    """tell() against a ticket that is unknown, already told, or from
+    an epoch that has been published over."""
+
+
+class TrialOffer(NamedTuple):
+    """One ask() result row: measure `config` and tell `ticket` its
+    QoR.  (`cached` offers carry a store-served QoR and need no tell —
+    the serving counters report them; ask() returns only live
+    tickets.)"""
+    ticket: int
+    config: Dict[str, Any]
+
+
+class _Pending(object):
+    """One epoch's host-side bookkeeping.  All accounting is LAZY:
+    rows are scanned, canon-deduped and memo-checked only as ask()
+    hands tickets out, so every request costs O(rows touched this
+    call), never O(B) — the serving plane's tail-latency contract
+    (an eager per-epoch pass put milliseconds of sha1/decode work
+    under the group lock on EVERY epoch-boundary ask, which is
+    exactly what BENCH_SERVE's ask p95 would have caught).
+
+    In-epoch dedup: rows with one canonical config share one ticket
+    and one measured value (the engine's own dedup would classify
+    them as duplicates anyway; a tenant should never be asked to
+    build the same config twice in one batch)."""
+
+    __slots__ = ("epoch", "version", "configs", "raw", "filled",
+                 "next_row", "by_canon", "group_rows", "group_value",
+                 "tickets")
+
+    def __init__(self, epoch, version: int, configs: List[dict]):
+        self.epoch = epoch
+        self.version = version
+        self.configs = configs
+        b = len(configs)
+        self.raw = np.full((b,), np.nan, np.float32)
+        self.filled = np.zeros((b,), bool)
+        self.next_row = 0                       # lazy scan cursor
+        self.by_canon: Dict[str, int] = {}      # canon -> dup-group
+        self.group_rows: List[List[int]] = []
+        self.group_value: List[Optional[float]] = []
+        self.tickets: Dict[int, int] = {}       # ticket id -> dup-group
+
+    def fill(self, g: int, value: float) -> None:
+        rows = self.group_rows[g]
+        self.raw[rows] = value
+        self.filled[rows] = True
+
+    @property
+    def unfilled(self) -> int:
+        return int((~self.filled).sum())
+
+    def settled(self) -> bool:
+        """Every row scanned, no ticket outstanding, every row filled
+        -> ready to commit."""
+        return (self.next_row >= len(self.configs)
+                and not self.tickets and self.unfilled == 0)
+
+
+class Session:
+    """One tenant bound to one group slot.  All methods take the
+    group's lock; everything host-visible (incumbent, counters) lives
+    here so `best` never touches the device."""
+
+    def __init__(self, group, slot: int, seed: int, *,
+                 store=None, session_id: Optional[str] = None):
+        self.group = group
+        self.slot = slot
+        self.seed = seed
+        self.id = session_id or uuid.uuid4().hex[:16]
+        self.store = store
+        self.version = 0            # published snapshots (commits)
+        self.pending: Optional[_Pending] = None
+        self.best_config: Optional[dict] = None
+        self.best_qor: Optional[float] = None
+        self.asks = 0
+        self.tells = 0
+        self.store_served = 0       # rows auto-filled from the memo
+        self.closed = False
+        self._ticket_seq = 0
+
+    # -- internals -----------------------------------------------------
+    def _offer_best(self, cfg: dict, qor: float) -> bool:
+        sign = self.group.engine.sign
+        if self.best_qor is None or sign * qor < sign * self.best_qor:
+            self.best_config, self.best_qor = cfg, float(qor)
+            obs.count("serve.new_bests")
+            return True
+        return False
+
+    def _new_pending(self) -> Optional[_Pending]:
+        """Build this session's pending epoch.  The group lock is NOT
+        held across the expensive host side — epoch materialization
+        (one stacked device->host pull) and config decode — so other
+        tenants' asks and tells proceed under it.  Returns None when
+        the epoch went stale between taking it and locking back in
+        (this session committed concurrently — only possible with
+        multiple clients driving one session); the ask loop then
+        retries."""
+        ep = self.group.pending_for(self)
+        configs = self.group.space.to_configs(ep.host_rows(self.slot))
+        with self.group.lock:
+            if ep.slot_gens[self.slot] != self.group.slot_gen[self.slot] \
+                    or self.pending is not None:
+                return self.pending
+            return self._adopt(ep, configs)
+
+    def _adopt(self, ep, configs: List[dict]) -> _Pending:
+        # memo/dedup accounting is deferred to ask()'s lazy row scan
+        p = _Pending(ep, self.version, configs)
+        self.pending = p
+        return p
+
+    def _scan_row(self, p: _Pending) -> Optional[TrialOffer]:
+        """Advance the lazy cursor one row: attach duplicates to their
+        group, auto-fill rows the cross-tenant memo already knows (any
+        config ANY tenant of this scope measured is served without a
+        build — and without a ticket), or mint a ticket.  Returns the
+        offer for live rows, None otherwise."""
+        r = p.next_row
+        p.next_row += 1
+        cfg = p.configs[r]
+        c = canon_config(cfg)
+        g = p.by_canon.get(c)
+        if g is not None:                   # in-epoch duplicate
+            p.group_rows[g].append(r)
+            v = p.group_value[g]
+            if v is not None:               # group already resolved
+                p.raw[r] = v
+                p.filled[r] = True
+            return None                     # else: fills at its tell
+        g = len(p.group_rows)
+        p.by_canon[c] = g
+        p.group_rows.append([r])
+        row = self.store.lookup(cfg) if self.store is not None else None
+        if row is not None:
+            q = float(row["qor"])
+            p.group_value.append(q)
+            p.raw[r] = q
+            p.filled[r] = True
+            self.store_served += 1
+            obs.count("serve.store_served")
+            self._offer_best(cfg, q)
+            return None
+        p.group_value.append(None)
+        t = self._ticket_seq
+        self._ticket_seq += 1
+        p.tickets[t] = g
+        return TrialOffer(t, cfg)
+
+    def _commit(self) -> None:
+        p = self.pending
+        self.group.commit(self, p.epoch, p.raw)
+        self.version += 1
+        self.pending = None
+
+    # -- the ask/tell surface ------------------------------------------
+    def ask(self, n: int = 1, max_auto: int = 4) -> List[TrialOffer]:
+        """Up to `n` trial offers from the current epoch.  Epochs fully
+        served by the store memo are committed and skipped (bounded by
+        `max_auto` per call); fewer than `n` offers — possibly none —
+        come back when the epoch's remaining rows are already ticketed
+        out (tell those first).  An epoch refresh only ENQUEUES device
+        work under the group lock (group.pending_for); the blocking
+        host pull + config decode run unlocked (_new_pending)."""
+        out: List[TrialOffer] = []
+        autos = 0
+        while not out:
+            with self.group.lock:
+                self._check_open()
+                p = self.pending
+            if p is None:
+                p = self._new_pending()
+                if p is None:
+                    continue        # raced a concurrent driver; retry
+            with self.group.lock:
+                if self.pending is not p:
+                    continue        # committed under us; take the next
+                while p.next_row < len(p.configs) and len(out) < n:
+                    offer = self._scan_row(p)
+                    if offer is not None:
+                        out.append(offer)
+                if out:
+                    self.asks += len(out)
+                    break
+                if p.settled():
+                    # every row memo-served: publish and move on
+                    self._commit()
+                    autos += 1
+                    if autos >= max_auto:
+                        break
+                    continue
+                break   # remaining rows already ticketed: tell first
+        obs.count("serve.asks", len(out))
+        return out
+
+    def tell(self, ticket: int, qor: Optional[float],
+             dur: float = 0.0) -> Dict[str, Any]:
+        """Report a ticket's USER-oriented QoR (None/NaN/inf = build
+        failure).  The tell completing the epoch publishes the next
+        snapshot version."""
+        with self.group.lock:
+            self._check_open()
+            p = self.pending
+            if p is None or ticket not in p.tickets:
+                raise StaleTicketError(
+                    f"ticket {ticket} is unknown, already told, or "
+                    f"from a published-over epoch (session "
+                    f"{self.id}, version {self.version})")
+            # convert BEFORE popping: a malformed qor (string, list)
+            # must leave the ticket live for a retry, not consume it
+            # and strand the epoch one row short of settled forever
+            v = float("nan") if qor is None else float(qor)
+            g = p.tickets.pop(ticket)
+            finite = v == v and abs(v) != float("inf")
+            p.group_value[g] = v if finite else float("nan")
+            p.fill(g, p.group_value[g])
+            cfg = p.configs[p.group_rows[g][0]]
+            new_best = False
+            if finite:
+                new_best = self._offer_best(cfg, v)
+            self.tells += 1
+            committed = False
+            if p.settled():
+                self._commit()
+                committed = True
+            version = self.version
+        # the memo write happens OUTSIDE the group lock (the store has
+        # its own lock; a racing reader either hits or re-measures —
+        # never a correctness matter), keeping disk appends off the
+        # group's serving path.  Best-effort to the end: the tell is
+        # already applied above, so a failed append (disk full, store
+        # closed by a racing stop) must not fail the response — that
+        # would report ok=False for an epoch that really committed
+        if self.store is not None:
+            try:
+                self.store.record(cfg, v if finite else None, dur,
+                                  source=f"serve:{self.id}")
+            except OSError:
+                obs.count("serve.store_write_errors")
+        obs.count("serve.tells")
+        return {"new_best": new_best, "committed": committed,
+                "version": version}
+
+    def best(self) -> Dict[str, Any]:
+        """Host-side incumbent (never a device sync)."""
+        with self.group.lock:
+            return {"config": self.best_config, "qor": self.best_qor,
+                    "version": self.version, "asks": self.asks,
+                    "tells": self.tells,
+                    "store_served": self.store_served}
+
+    def close(self) -> None:
+        with self.group.lock:
+            if not self.closed:
+                self.closed = True
+                self.pending = None
+                self.group.leave(self)
+
+    def _check_open(self) -> None:
+        if self.closed:
+            raise StaleTicketError(f"session {self.id} is closed")
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class LocalSession:
+    """The offline sibling: identical session mechanics on a private
+    single-slot group, no server, no sockets.
+
+        with LocalSession(space, seed=3) as s:
+            while budget:
+                for t in s.ask(8):
+                    s.tell(t.ticket, measure(t.config))
+        s.best()
+
+    Matched seeds make this bitwise equal to a server session — the
+    parity bar tests/test_serve.py holds the multiplexed plane to —
+    and it is the bench's sequential per-session baseline."""
+
+    def __init__(self, space, seed: int = 0, *,
+                 arms: Optional[Sequence[str]] = None,
+                 sense: str = "min", history_capacity: int = 1 << 10,
+                 store=None):
+        from .group import SessionGroup
+        self._group = SessionGroup(space, 1, arms=arms, sense=sense,
+                                   history_capacity=history_capacity)
+        self._session = self._group.join(seed, store=store)
+
+    def ask(self, n: int = 1, **kw) -> List[TrialOffer]:
+        return self._session.ask(n, **kw)
+
+    def tell(self, ticket: int, qor: Optional[float],
+             dur: float = 0.0) -> Dict[str, Any]:
+        return self._session.tell(ticket, qor, dur)
+
+    def best(self) -> Dict[str, Any]:
+        return self._session.best()
+
+    @property
+    def version(self) -> int:
+        return self._session.version
+
+    def close(self) -> None:
+        self._session.close()
+
+    def __enter__(self) -> "LocalSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -188,6 +188,16 @@ class Space:
         return (f"Space(D={self.n_scalar} scalar lanes, "
                 f"perms={list(self.perm_sizes)}, params={len(self.specs)})")
 
+    def signature(self) -> List[str]:
+        """Ordered structural signature: spec dataclass reprs carry
+        name, kind, bounds, options/items.  Shared identity across the
+        planes that must agree on "the same space": the driver's
+        archive header (position-indexed unit-vector replay), the
+        results store's scope key, and the session server's tenant
+        grouping (equal signatures => one BatchedEngine instance
+        axis)."""
+        return [repr(s) for s in self.specs]
+
     def search_space_size(self) -> float:
         """Product of per-parameter sizes (manipulator.py:245-247)."""
         out = 1.0

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -74,6 +75,19 @@ class ResultStore:
                  refresh_interval: float = 2.0):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        # the session server shares ONE store handle between its
+        # per-connection threads (the cross-tenant memo), so the
+        # table/offset/segment mutations take a reentrant lock; the
+        # single-threaded driver path pays one uncontended acquire per
+        # lookup/record.  CROSS-PROCESS safety was never the lock's
+        # job — that is the O_APPEND segment protocol.  Disk appends
+        # take _io_lock INSTEAD so a lookup (held under a tenant
+        # group's lock in the serving plane) never waits on another
+        # tenant's os.write; acquire order is _lock -> _io_lock,
+        # never the reverse
+        self._lock = threading.RLock()
+        self._io_lock = threading.Lock()
+        self._closed = False
         self.eval_sig = eval_signature(command, stage,
                                        extra_files=extra_files, env=env)
         self.scope = scope_id(list(space_sig), self.eval_sig)
@@ -182,11 +196,12 @@ class ResultStore:
         the number of FOREIGN rows read (this instance's own segment is
         never re-read — its rows entered memory at record() time), so a
         truthy refresh really means siblings produced something."""
-        self._last_refresh = time.monotonic()
-        with obs.span("store.refresh") as sp:
-            n = self._load_all()
-            sp.set(rows=n)
-        return n
+        with self._lock:
+            self._last_refresh = time.monotonic()
+            with obs.span("store.refresh") as sp:
+                n = self._load_all()
+                sp.set(rows=n)
+            return n
 
     def maybe_refresh(self) -> int:
         """Time-gated refresh() for call sites inside hot loops."""
@@ -196,26 +211,30 @@ class ResultStore:
 
     # -- queries -------------------------------------------------------
     def __len__(self) -> int:
-        return len(self._rows)
+        with self._lock:
+            return len(self._rows)
 
     def lookup(self, cfg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         """The recorded row for this config under THIS scope, or None.
         Only successful (finite-QoR) rows are served; failure rows are
         re-measured (see module docstring)."""
-        row = self._rows.get(trial_key(self.scope, cfg))
-        if row is not None and _finite(row.get("qor")):
-            self.hits += 1
-            obs.count("store.hits")
-            return row
-        self.misses += 1
-        obs.count("store.misses")
-        return None
+        with self._lock:
+            row = self._rows.get(trial_key(self.scope, cfg))
+            if row is not None and _finite(row.get("qor")):
+                self.hits += 1
+                obs.count("store.hits")
+                return row
+            self.misses += 1
+            obs.count("store.misses")
+            return None
 
     def scope_rows(self) -> List[Dict[str, Any]]:
         """All finite rows recorded for this (space, eval) scope — the
         warm-start training/replay set."""
-        return [r for r in self._rows.values()
-                if r.get("scope") == self.scope and _finite(r.get("qor"))]
+        with self._lock:
+            return [r for r in self._rows.values()
+                    if r.get("scope") == self.scope
+                    and _finite(r.get("qor"))]
 
     def best_row(self, sense: str = "min") -> Optional[Dict[str, Any]]:
         rows = self.scope_rows()
@@ -228,19 +247,25 @@ class ResultStore:
         """Finite in-scope rows merged from SIBLING instances since the
         last call (rows present at open never appear): the exchange
         plane's delta feed.  Consuming clears the set."""
-        if not self._fresh_foreign:
-            return []
-        keys, self._fresh_foreign = self._fresh_foreign, set()
-        out = []
-        for k in keys:
-            r = self._rows.get(k)
-            if r is not None and r.get("scope") == self.scope \
-                    and _finite(r.get("qor")):
-                out.append(r)
-        return out
+        with self._lock:
+            if not self._fresh_foreign:
+                return []
+            keys, self._fresh_foreign = self._fresh_foreign, set()
+            out = []
+            for k in keys:
+                r = self._rows.get(k)
+                if r is not None and r.get("scope") == self.scope \
+                        and _finite(r.get("qor")):
+                    out.append(r)
+            return out
 
     # -- writes --------------------------------------------------------
     def _append(self, row: Dict[str, Any]) -> None:
+        if self._closed:
+            # a record() racing close() (server stop vs an in-flight
+            # tell) must not resurrect the segment: reopening here
+            # would leak the fd and leave a stray seg file behind
+            return
         if self._seg_fd is None:
             self._seg_fd = os.open(
                 self._seg_path,
@@ -257,25 +282,32 @@ class ResultStore:
         failure).  Returns the stored row, or None when an equal-or-
         better row for the key already exists (idempotent re-records,
         e.g. archive ingestion over a live store, append nothing)."""
-        k = trial_key(self.scope, cfg)
-        cur = self._rows.get(k)
-        if cur is not None and (_finite(cur.get("qor"))
-                                or not _finite(qor)):
-            return None
-        row: Dict[str, Any] = {
-            "k": k, "scope": self.scope, "cfg": cfg,
-            "qor": (float(qor) if _finite(qor) else None),
-            "dur": round(float(dur), 6), "t": round(time.time(), 3),
-            "src": source or self.instance,
-        }
-        if u is not None:
-            row["u"] = [float(x) for x in u]
-        if perms is not None:
-            row["perms"] = [[int(i) for i in p] for p in perms]
-        self._append(row)
-        self._rows[k] = row
-        self.recorded += 1
-        obs.count("store.recorded")
+        with self._lock:
+            k = trial_key(self.scope, cfg)
+            cur = self._rows.get(k)
+            if cur is not None and (_finite(cur.get("qor"))
+                                    or not _finite(qor)):
+                return None
+            row: Dict[str, Any] = {
+                "k": k, "scope": self.scope, "cfg": cfg,
+                "qor": (float(qor) if _finite(qor) else None),
+                "dur": round(float(dur), 6), "t": round(time.time(), 3),
+                "src": source or self.instance,
+            }
+            if u is not None:
+                row["u"] = [float(x) for x in u]
+            if perms is not None:
+                row["perms"] = [[int(i) for i in p] for p in perms]
+            self._rows[k] = row
+            self.recorded += 1
+            obs.count("store.recorded")
+        # the disk append runs outside _lock (lookups on the serving
+        # path must not queue behind it); _io_lock serializes fd use.
+        # Same-key dedup already resolved above, and segment line
+        # ORDER across threads is irrelevant — rows are keyed and
+        # duplicate keys merge away on load
+        with self._io_lock:
+            self._append(row)
         return row
 
     def ingest_archive(self, path: str) -> int:
@@ -308,36 +340,55 @@ class ResultStore:
         """Merge every visible row into a fresh ``base.jsonl`` (atomic
         rename) and truncate this instance's own segment.  Other
         instances' segments are left alone — their rows are now ALSO in
-        the base, and duplicate keys merge away on load."""
-        self.refresh()
-        # per-instance tmp name: two siblings compacting concurrently
-        # must not truncate each other's in-flight snapshot (each
-        # publishes a FULL merged view, so last-rename-wins is safe)
-        tmp = os.path.join(self.root, f"base.jsonl.{self.instance}.tmp")
-        with open(tmp, "w") as f:
-            for row in self._rows.values():
-                f.write(json.dumps(row, separators=(",", ":")) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        base = os.path.join(self.root, "base.jsonl")
-        os.replace(tmp, base)
-        # base content changed identity: re-read it from 0 next refresh
-        self._offsets.pop(base, None)
-        self._read_new_lines(base)
-        if self._seg_fd is not None:
-            os.close(self._seg_fd)
-            self._seg_fd = None
-        try:
-            os.unlink(self._seg_path)
-        except OSError:
-            pass
-        self._offsets.pop(self._seg_path, None)
-        return len(self._rows)
+        the base, and duplicate keys merge away on load.
+
+        Under ``_lock`` like the serving-path methods: a shared-handle
+        tenant thread's record() must not grow ``_rows`` mid-iteration
+        or write to the segment fd while compact closes it."""
+        with self._lock:
+            self.refresh()
+            # per-instance tmp name: two siblings compacting
+            # concurrently must not truncate each other's in-flight
+            # snapshot (each publishes a FULL merged view, so
+            # last-rename-wins is safe)
+            tmp = os.path.join(self.root,
+                               f"base.jsonl.{self.instance}.tmp")
+            with open(tmp, "w") as f:
+                for row in self._rows.values():
+                    f.write(json.dumps(row, separators=(",", ":"))
+                            + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            base = os.path.join(self.root, "base.jsonl")
+            os.replace(tmp, base)
+            # base content changed identity: re-read from 0 next
+            # refresh
+            self._offsets.pop(base, None)
+            self._read_new_lines(base)
+            with self._io_lock:
+                # close AND unlink under one _io_lock hold: releasing
+                # between them lets a racing _append reopen the path,
+                # and the unlink would then strand that fd on an
+                # unlinked inode silently swallowing every later row
+                if self._seg_fd is not None:
+                    os.close(self._seg_fd)
+                    self._seg_fd = None
+                try:
+                    os.unlink(self._seg_path)
+                except OSError:
+                    pass
+            self._offsets.pop(self._seg_path, None)
+            return len(self._rows)
 
     def close(self) -> None:
-        if self._seg_fd is not None:
-            os.close(self._seg_fd)
-            self._seg_fd = None
+        # the serving plane shares one handle across tenant threads,
+        # so a close must not race a record()'s in-flight os.write —
+        # _io_lock is the fd-lifecycle lock
+        with self._io_lock:
+            self._closed = True
+            if self._seg_fd is not None:
+                os.close(self._seg_fd)
+                self._seg_fd = None
 
     def __enter__(self) -> "ResultStore":
         return self
