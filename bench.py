@@ -173,6 +173,142 @@ def _utilization(device_kind: str, flops_per_s, bytes_per_s):
     return {}
 
 
+def _obs_merged_example(repo: str) -> dict:
+    """Produce the committed distributed-trace artifact
+    (exp_archives/obs_trace_merged_example.json): REAL telemetry from
+    four distinct OS processes — a traced `ut` driver run (whose worker
+    lanes carry reap-merged child sidecar spans), one standalone worker
+    child's own sidecar shard, a `ut serve` server shut down by SIGINT
+    (exercising the exit-flush path), and a traced client whose
+    requests carry trace context — joined by `ut-trace merge` with
+    clock-offset alignment.  Returns the manifest recorded into
+    BENCH_OBS.json; the document is validate_trace-clean or this
+    raises."""
+    import re
+    import signal
+    import subprocess
+    import tempfile
+    import textwrap
+
+    from uptune_tpu.obs import merge as obs_merge
+    from uptune_tpu.utils.pypath import child_pythonpath
+
+    work = tempfile.mkdtemp(prefix="ut_obs_merged")
+    prog = os.path.join(work, "prog.py")
+    with open(prog, "w") as f:
+        f.write(textwrap.dedent("""
+            import uptune_tpu as ut
+            x = ut.tune(50, (0, 100), name="x")
+            y = ut.tune(50, (0, 100), name="y")
+            ut.target(float((x - 37) ** 2 + (y - 11) ** 2), "min")
+        """))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("UT_TRACE", "UT_TRACE_GUARD", "UT_TRACE_SIDECAR",
+                        "UT_PROCESS_ID")}
+    env.update(PYTHONPATH=child_pythonpath(), JAX_PLATFORMS="cpu")
+
+    # shard 1: the driver — a traced `ut` run (2 worker slots)
+    tune_trace = os.path.join(work, "tune_trace.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "uptune_tpu.cli", prog, "--test-limit",
+         "6", "-pf", "2", "--store", "off", "--trace", tune_trace,
+         "--work-dir", work], env=env, cwd=work, capture_output=True,
+        text=True, timeout=600)
+    if r.returncode != 0 or not os.path.isfile(tune_trace):
+        raise RuntimeError(f"driver shard failed:\n{r.stdout}\n{r.stderr}")
+
+    # shard 2: one worker child's OWN sidecar (reap consumes the tune's
+    # sidecars after folding them into the driver shard, so run one
+    # trial standalone against a sandbox the tune already populated)
+    child_shard = os.path.join(work, "child_shard.jsonl")
+    sandbox = os.path.join(work, "ut.temp", "temp.0")
+    cenv = dict(env, UT_TUNE_START="True", UT_CURR_INDEX="0",
+                UT_CURR_STAGE="0", UT_GLOBAL_ID="9001",
+                UT_WORK_DIR=sandbox, UT_TRACE_SIDECAR=child_shard)
+    r = subprocess.run([sys.executable, prog], env=cenv, cwd=sandbox,
+                       capture_output=True, text=True, timeout=120)
+    if r.returncode != 0 or not os.path.isfile(child_shard):
+        raise RuntimeError(f"child shard failed:\n{r.stdout}\n{r.stderr}")
+
+    # shards 3+4: `ut serve` + a traced client over real TCP; the
+    # server is stopped with SIGINT, so its shard exists only because
+    # the exit flush works (the satellite, exercised for real)
+    srv_trace = os.path.join(work, "srv_trace.json")
+    cli_trace = os.path.join(work, "client_trace.json")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "uptune_tpu.serve.cli", "--port", "0",
+         "--slots", "2", "--store-dir", "off", "--trace", srv_trace],
+        env=env, cwd=work, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            line = srv.stderr.readline()
+            if not line:
+                break
+            m = re.search(r"listening on [^:]+:(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        if port is None:
+            raise RuntimeError("serve shard: no listening line")
+        client_py = os.path.join(work, "client.py")
+        with open(client_py, "w") as f:
+            f.write(textwrap.dedent(f"""
+                from uptune_tpu import obs
+                from uptune_tpu.serve.client import connect
+                from uptune_tpu.workloads import rosenbrock_space
+                obs.enable()
+                c = connect(("127.0.0.1", {port}))
+                s = c.open_session(rosenbrock_space(2, -2.0, 2.0),
+                                   seed=3, program="merged-example",
+                                   store=False)
+                for _ in range(3):
+                    for t in s.ask(2):
+                        s.tell(t.ticket, sum(v * v
+                               for v in t.config.values()))
+                c.metrics(format="prometheus")
+                s.close(); c.close()
+                obs.write_trace({cli_trace!r},
+                                extra={{"process": "ut-client"}})
+            """))
+        r = subprocess.run([sys.executable, client_py], env=env,
+                           cwd=work, capture_output=True, text=True,
+                           timeout=300)
+        if r.returncode != 0 or not os.path.isfile(cli_trace):
+            raise RuntimeError(
+                f"client shard failed:\n{r.stdout}\n{r.stderr}")
+    finally:
+        srv.send_signal(signal.SIGINT)
+        try:
+            srv.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            srv.kill()
+            srv.wait(timeout=30)
+    if not os.path.isfile(srv_trace):
+        raise RuntimeError("serve shard: SIGINT flush left no trace")
+
+    out = os.path.join(repo, "exp_archives",
+                       "obs_trace_merged_example.json")
+    doc = obs_merge.merge_files(
+        [tune_trace, child_shard, srv_trace, cli_trace], out=out)
+    manifest = doc["otherData"]["merged"]
+    procs = {s["process"] for s in manifest}
+    if len(procs) < 3:
+        raise RuntimeError(f"merged example spans only {procs}")
+    if doc["otherData"]["joins"] < 1:
+        raise RuntimeError("no client/server span joins in the merged "
+                           "example")
+    return {"file": "exp_archives/obs_trace_merged_example.json",
+            "processes": sorted(procs),
+            "shards": [{k: s[k] for k in ("process", "events",
+                                          "offset_s")}
+                       for s in manifest],
+            "events": len(doc["traceEvents"]),
+            "client_server_joins": doc["otherData"]["joins"]}
+
+
 def obs_main() -> None:
     """`bench.py --obs`: the observability-plane overhead benchmark —
     the cost of the instrumentation itself, in both of its states
@@ -187,7 +323,9 @@ def obs_main() -> None:
 
     Phase 2 (enabled path): same protocol, same process, tracing ON
     with the full span/counter stream recording into the per-thread
-    rings.  Must hold >= 95% of the disabled-path rate.
+    rings AND the metrics flight recorder appending timeline rows in
+    the background (the ISSUE 10 deployment shape).  Must hold >= 95%
+    of the disabled-path rate.
 
     Phase 3 (full runs only): the async-surrogate warm-window check —
     the PR 5 protocol (rosenbrock-2d, calibrated opts at max_points
@@ -198,6 +336,11 @@ def obs_main() -> None:
     just cleared.  This phase's trace is exported as the committed
     example artifact (exp_archives/obs_trace_example.json) — driver
     lane + refit-worker lane, validated by the schema test.
+
+    Phase 4 (full runs only): the distributed-trace artifact — a
+    traced driver run, a worker child's sidecar shard, a SIGINT'd
+    `ut serve` server and a traced client, merged by `ut-trace merge`
+    into exp_archives/obs_trace_merged_example.json (ISSUE 10).
 
     Run under UT_TRACE_GUARD=strict to also prove tracing adds no
     retraces."""
@@ -242,7 +385,11 @@ def obs_main() -> None:
         # trials) so the cross-artifact asks/s comparison is
         # like-for-like in measurement length
         window = 500 if quick else 2000
-        reps = 3
+        # 5 reps per mode since ISSUE 10 (was 3): this box's
+        # co-tenant throughput swings got wider (~2x within a single
+        # run's reps), and best-of needs more draws to catch each
+        # mode's uncontended rate
+        reps = 3 if quick else 5
         drain(200)                      # compile warmup (both phases)
 
         def timed_window():
@@ -259,13 +406,24 @@ def obs_main() -> None:
         # (BENCH_r0* history), so back-to-back single phases would
         # measure the weather — interleaving puts both modes under the
         # same bursts and min-wall picks each mode's uncontended rate
-        # (the same best-of-reps rule as the engine benches)
+        # (the same best-of-reps rule as the engine benches).  The
+        # enabled windows ALSO run the metrics flight recorder (the
+        # deployment shape since ISSUE 10: tracing on means the
+        # background timeline thread is on), so the >= 0.95 bar prices
+        # in its periodic window_snapshot + disk append
+        import tempfile
         d_reps, e_reps = [], []
         events_recorded = events_dropped = 0
-        for _ in range(reps):
+        flight_rows = 0
+        fdir = tempfile.mkdtemp(prefix="ut_bench_obs")
+        for rep in range(reps):
             d_reps.append(timed_window())
             obs.enable(capacity=1 << 18)
+            rec = obs.start_flight_recorder(
+                os.path.join(fdir, f"rep{rep}.json"), interval=0.25)
             e_reps.append(timed_window())
+            rec.stop()
+            flight_rows = max(flight_rows, rec.rows_written)
             snap = obs.snapshot()
             events_recorded = len(snap["events"])
             events_dropped = sum(snap["dropped"].values())
@@ -282,6 +440,8 @@ def obs_main() -> None:
         enabled = mode_result(e_reps)
         enabled["events_recorded"] = events_recorded
         enabled["events_dropped"] = events_dropped
+        enabled["flight_recorder"] = {"interval_s": 0.25,
+                                      "rows_per_window": flight_rows}
 
     surro = None
     with guard_from_env() as guard3:
@@ -343,6 +503,16 @@ def obs_main() -> None:
             surro["trace_events"] = len(doc["traceEvents"])
             obs.reset()
 
+    merged = None
+    if not quick:
+        # phase 4: the distributed-observability artifact — four real
+        # processes (driver, worker child, serve server, serve client)
+        # merged into one validate_trace-clean document (ISSUE 10
+        # acceptance; the committed example tests/test_obs_distributed
+        # validates)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        merged = _obs_merged_example(repo)
+
     drv_baseline = None
     drv = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "BENCH_DRIVER.json")
@@ -396,6 +566,8 @@ def obs_main() -> None:
     if surro is not None:
         result["surrogate_traced"] = surro
         result["surrogate_warm_p95_baseline_ms"] = surro_baseline
+    if merged is not None:
+        result["merged_trace_example"] = merged
     if guard.enabled:
         result["retraces"] = {"driver_phases": guard.report(),
                               "surrogate_phase": guard3.report()}

@@ -155,7 +155,13 @@ class TestCLI:
         assert "de" in names or any("de" in n for n in names)
         assert len(names) >= 30
 
+    @pytest.mark.slow
     def test_tune_and_apply_best(self, tmp_path):
+        """Slow-marked for suite-budget headroom (ISSUE 10, ~21 s):
+        the CLI tune loop stays tier-1 via test_store's full `ut`
+        strict-guard e2e and the seed-config CLI runs below, and
+        --apply-best keeps the fast tier-1 sibling
+        test_apply_best_serves_stored_best."""
         shutil.copy(os.path.join(SAMPLES, "hash", "single_stage.py"),
                     tmp_path / "prog.py")
         out = self._run(["prog.py", "-pf", "2", "--test-limit", "15",
@@ -167,6 +173,22 @@ class TestCLI:
         # --apply-best re-runs the program with the stored best
         out2 = self._run(["prog.py", "--apply-best"], str(tmp_path))
         assert out2.returncode == 0, out2.stderr[-800:]
+
+    def test_apply_best_serves_stored_best(self, tmp_path):
+        """Fast --apply-best sibling: a hand-written best.json is
+        served to the program (BEST mode) without any prior tune —
+        one subprocess instead of a 15-trial run."""
+        prog = tmp_path / "prog.py"
+        prog.write_text(
+            "import uptune_tpu as ut\n"
+            "x = ut.tune(1, (0, 100), name='x')\n"
+            "print('SERVED', x)\n"
+            "ut.target(float(x), 'min')\n")
+        (tmp_path / "best.json").write_text(
+            json.dumps({"config": {"x": 73}, "qor": 73.0}))
+        out = self._run(["prog.py", "--apply-best"], str(tmp_path))
+        assert out.returncode == 0, out.stderr[-800:]
+        assert "SERVED 73" in out.stdout
 
     def test_learning_model_session_fallback(self, tmp_path):
         """ProgramTuner honors ut.config({'learning-model': ...}) when

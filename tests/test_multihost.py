@@ -18,6 +18,23 @@ jax = pytest.importorskip("jax")
 from uptune_tpu.parallel import (distributed_config,  # noqa: E402
                                  is_coordinator, make_multihost_mesh)
 
+# some jax builds cannot run REAL multi-process collectives on the CPU
+# backend ("Multiprocess computations aren't implemented ..."): a
+# capability gap in the environment, not a regression in this repo —
+# detect it from the worker's own failure and skip cleanly instead of
+# failing the suite (CHANGES.md PR 8 noted the drift)
+_MULTIPROC_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented",
+    "multi-process deployments are not supported on the CPU backend",
+)
+
+
+def _skip_if_multiproc_unsupported(rc: int, out: str, err: str) -> None:
+    if rc != 0 and any(m in out + err for m in _MULTIPROC_UNSUPPORTED):
+        pytest.skip("this jax build's CPU backend does not implement "
+                    "multi-process collectives (environment "
+                    "capability, not a repo regression)")
+
 
 class TestConfig:
     def test_single_process_defaults(self, monkeypatch):
@@ -84,6 +101,7 @@ class TestTwoProcess:
                 for q in procs:
                     q.kill()
                 pytest.fail("multihost worker hung")
+            _skip_if_multiproc_unsupported(p.returncode, out, err)
             assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
             outs.append(out)
         bests = []
@@ -203,6 +221,7 @@ def _communicate_all(procs, timeout):
             for q in procs:
                 q.kill()
             pytest.fail("multihost worker hung")
+        _skip_if_multiproc_unsupported(p.returncode, out, err)
         assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
         outs.append(out)
     return outs

@@ -145,7 +145,13 @@ class TestRegistryAdditions:
             [t.name for t in c.techniques] or len(a.techniques) != \
             len(c.techniques)
 
+    @pytest.mark.slow
     def test_generated_portfolio_tunes(self):
+        """Slow-marked for suite-budget headroom (ISSUE 10, ~12 s —
+        a 400-trial generated-portfolio tune): generation validity
+        keeps tier-1 coverage via test_generate_bandit_deterministic,
+        the bandit-mutation convergence/credit tests, and the
+        composable-operator tests in this file."""
         from uptune_tpu.driver.driver import Tuner
         space = _space()
 

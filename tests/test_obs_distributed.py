@@ -1,0 +1,495 @@
+"""Distributed observability (ISSUE 10, docs/OBSERVABILITY.md):
+subprocess trace sidecars merged at reap, the `ut-trace merge` shard
+joiner with clock-offset alignment, the metrics flight recorder's
+timeline (writer thread vs scrape losing nothing), Prometheus text
+exposition, `ut top` rendering, and graceful SIGINT/atexit telemetry
+flushing.  The serve-plane halves (wire ctx propagation, Prometheus
+scrape op) live in tests/test_serve.py beside the shared server
+fixture.
+
+Budget note: everything here is in-process and sub-second except the
+@slow real-subprocess e2e at the bottom — each slow test keeps a cheap
+tier-1 sibling (the simulated-sidecar merge, the committed merged
+artifact)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+import uptune_tpu
+from uptune_tpu import obs
+from uptune_tpu.obs import flight, merge, sidecar, top
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+ENV = {"PYTHONPATH": REPO}
+
+
+@pytest.fixture(autouse=True)
+def obs_clean():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ---------------------------------------------------------------- core
+class TestCoreAdditions:
+    def test_span_ids_unique_and_pid_tagged(self):
+        ids = {obs.new_span_id() for _ in range(64)}
+        assert len(ids) == 64
+        assert all(i.startswith(f"{os.getpid():x}-") for i in ids)
+
+    def test_emit_at_places_events_on_explicit_ts(self):
+        obs.enable()
+        obs.emit_at("a", 1.5, 0.25, "lane-x", {"k": 1})
+        obs.emit_at("b", 2.0)                       # instant, own lane
+        evs = obs.snapshot()["events"]
+        a = next(e for e in evs if e["name"] == "a")
+        assert (a["ts"], a["dur"], a["track"]) == (1.5, 0.25, "lane-x")
+        b = next(e for e in evs if e["name"] == "b")
+        assert b["dur"] is None and b["track"] == "MainThread"
+
+    def test_emit_at_disabled_is_inert(self):
+        obs.emit_at("a", 1.0, 1.0, "lane")
+        obs.enable()
+        assert obs.snapshot()["events"] == []
+
+
+# ------------------------------------------------------------- sidecar
+class TestSidecar:
+    def test_dump_read_roundtrip_and_merge_alignment(self, tmp_path):
+        """The tier-1 sibling of the @slow subprocess e2e: a simulated
+        child dumps its rings to a sidecar; a 'driver' (same process,
+        fresh enable cycle) merges them onto a worker lane with the
+        clock offset applied, and the file is consumed."""
+        path = str(tmp_path / sidecar.SIDECAR_FILE)
+        obs.enable()
+        with obs.span("child.load_proposal"):
+            pass
+        obs.event("child.target", qor=1.25)
+        child_origin = obs.trace_origin_unix()
+        sidecar.dump(path)
+        header, events = sidecar.read(path)
+        assert header["sidecar"] == 1
+        assert header["origin_unix"] == child_origin
+        names = {e["name"] for e in events}
+        assert {"child.load_proposal", "child.target",
+                "child.run"} <= names
+
+        obs.enable()                    # the "driver" side: new origin
+        n = sidecar.merge_into(path, "worker-3")
+        assert n == len(events)
+        assert not os.path.exists(path), "consumed sidecar must go"
+        evs = obs.snapshot()["events"]
+        merged = [e for e in evs if e["name"].startswith("child.")]
+        assert {e["track"] for e in merged} == {"worker-3"}
+        # clock alignment: child events recorded BEFORE the driver's
+        # enable() land at negative trace time, never at raw child time
+        offset = child_origin - obs.trace_origin_unix()
+        tgt = next(e for e in merged if e["name"] == "child.target")
+        src = next(e for e in events if e["name"] == "child.target")
+        assert abs(tgt["ts"] - (src["ts"] + offset)) < 1e-9
+        assert tgt["attrs"]["qor"] == 1.25
+
+    def test_read_tolerates_garbage_and_torn_tails(self, tmp_path):
+        p = tmp_path / "x.jsonl"
+        assert sidecar.read(str(p)) is None             # missing
+        p.write_text("")
+        assert sidecar.read(str(p)) is None             # empty
+        p.write_text('{"not": "a sidecar"}\n')
+        assert sidecar.read(str(p)) is None             # wrong header
+        p.write_text('{"sidecar": 1, "origin_unix": 5.0}\n'
+                     '{"name": "a", "ts": 0.1, "dur": null}\n'
+                     '{"name": "b", "ts"')               # torn tail
+        header, events = sidecar.read(str(p))
+        assert [e["name"] for e in events] == ["a"]
+
+    def test_merge_into_disabled_or_missing_is_zero(self, tmp_path):
+        assert sidecar.merge_into(str(tmp_path / "nope"), "w") == 0
+        obs.enable()
+        assert sidecar.merge_into(str(tmp_path / "nope"), "w") == 0
+
+    def test_maybe_init_child_env_gate(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(sidecar.SIDECAR_ENV, raising=False)
+        assert sidecar.maybe_init_child() is None
+        assert not obs.enabled()
+        path = str(tmp_path / "sc.jsonl")
+        monkeypatch.setenv(sidecar.SIDECAR_ENV, path)
+        assert sidecar.maybe_init_child() == path
+        assert obs.enabled()
+        # idempotent: re-init (protocol state reset) doesn't stack
+        assert sidecar.maybe_init_child() == path
+
+
+# ----------------------------------------------------- flight recorder
+class TestFlightRecorder:
+    def test_timeline_rows_lose_nothing_under_concurrency(self,
+                                                          tmp_path):
+        """ISSUE 10 satellite: the writer thread snapshots windows
+        while worker threads hammer the registry — the sum of per-row
+        deltas equals the final counters exactly (the lock makes every
+        row a consistent cut), and histogram window counts add up."""
+        obs.enable()
+        path = str(tmp_path / "m.metrics.jsonl")
+        rec = flight.start(path, interval=0.02)
+        n_threads, per = 4, 300
+        start = threading.Barrier(n_threads)
+
+        def writer(k):
+            start.wait()
+            for i in range(per):
+                obs.count("t.counter")
+                obs.observe("t.hist", float(i))
+
+        ts = [threading.Thread(target=writer, args=(k,))
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        rec.stop()
+        rec.stop()                          # idempotent
+        rows = [json.loads(l) for l in open(path)]
+        assert len(rows) >= 1
+        assert rows[-1]["final"] is True
+        total = n_threads * per
+        assert sum(r["deltas"].get("t.counter", 0) for r in rows) \
+            == total
+        assert rows[-1]["counters"]["t.counter"] == total
+        assert sum(r["hists"].get("t.hist", {}).get("window_count", 0)
+                   for r in rows) == total
+        # rows carry their window length for rate computation
+        assert all(r["dt"] >= 0 for r in rows)
+
+    def test_rotation_caps_the_file(self, tmp_path):
+        obs.enable()
+        path = str(tmp_path / "r.metrics.jsonl")
+        rec = flight.FlightRecorder(path, interval=60, max_rows=5)
+        rec.start()
+        for _ in range(12):
+            obs.count("c")
+            rec._write_row()
+        rec.stop()
+        assert rec.rotations == 2
+        assert os.path.exists(path + ".1")
+        kept = sum(1 for _ in open(path)) + sum(
+            1 for _ in open(path + ".1"))
+        assert kept <= 11                   # bounded, not unbounded
+
+    def test_finish_settles_recorder_not_legacy_row(self, tmp_path):
+        """obs.finish on a traced run with a recorder stops it (final
+        row) instead of appending the legacy one-shot snapshot — and a
+        second finish (clean exit after a signal flush) appends
+        nothing more."""
+        obs.enable()
+        trace = str(tmp_path / "t.json")
+        obs.start_flight_recorder(trace, interval=60)
+        obs.count("x")
+        obs.finish(trace)
+        rows = [json.loads(l)
+                for l in open(trace + ".metrics.jsonl")]
+        assert rows[-1]["final"] is True
+        n = len(rows)
+        obs.finish(trace)
+        rows2 = [json.loads(l)
+                 for l in open(trace + ".metrics.jsonl")]
+        assert len(rows2) == n
+        obs.validate_trace(json.load(open(trace)))
+
+    def test_window_snapshot_cursor_math(self):
+        obs.enable()
+        obs.count("a", 3)
+        obs.observe("h", 1.0)
+        row, cur = obs.window_snapshot(None)
+        assert row["deltas"]["a"] == 3
+        assert row["hists"]["h"]["window_count"] == 1
+        obs.count("a", 2)
+        row2, _ = obs.window_snapshot(cur)
+        assert row2["deltas"]["a"] == 2
+        assert row2["counters"]["a"] == 5
+        assert row2["hists"]["h"]["window_count"] == 0
+        assert "p50" not in row2["hists"]["h"]
+
+
+# ---------------------------------------------------------- prometheus
+class TestPrometheus:
+    def test_exposition_families(self):
+        obs.enable()
+        obs.count("serve.asks", 7)
+        obs.gauge("pool.utilization", 0.5)
+        for v in (1.0, 2.0, 3.0):
+            obs.observe("serve.ask_ms", v)
+        text = obs.prometheus_text()
+        assert "# TYPE ut_serve_asks counter\nut_serve_asks 7" in text
+        assert "# TYPE ut_pool_utilization gauge" in text
+        assert 'ut_serve_ask_ms{quantile="0.5"} 2' in text
+        assert "ut_serve_ask_ms_count 3" in text
+        assert "ut_serve_ask_ms_sum 6" in text
+
+    def test_name_sanitization(self):
+        obs.enable()
+        obs.count("weird.name-with:chars/2", 1)
+        text = obs.prometheus_text()
+        assert "ut_weird_name_with_chars_2 1" in text
+
+
+# --------------------------------------------------------------- merge
+def _make_shard(tmp_path, name, process, origin, events):
+    """A normalized chrome shard written through the real exporter
+    pipeline would share this process's clock; build documents by hand
+    instead so distinct origins (distinct fake hosts) are testable."""
+    doc = {"traceEvents": [
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "MainThread"}}] + events,
+        "otherData": {"process": process, "origin_unix": origin}}
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+class TestMerge:
+    def test_merge_aligns_clocks_and_namespaces_pids(self, tmp_path):
+        a = _make_shard(tmp_path, "a.json", "proc-a", 1000.0, [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "s", "ts": 0.0,
+             "dur": 1e6}])
+        b = _make_shard(tmp_path, "b.json", "proc-b", 1002.5, [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "s", "ts": 0.0,
+             "dur": 1e6}])
+        out = str(tmp_path / "merged.json")
+        doc = merge.merge_files([a, b], out=out)
+        obs.validate_trace(doc)
+        obs.validate_trace(json.load(open(out)))
+        procs = {e["args"]["name"]: e["pid"]
+                 for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert set(procs) == {"proc-a", "proc-b"}
+        assert len(set(procs.values())) == 2
+        xs = {e["pid"]: e["ts"] for e in doc["traceEvents"]
+              if e["ph"] == "X"}
+        # shard b's span is shifted by its 2.5 s clock offset
+        assert xs[procs["proc-b"]] - xs[procs["proc-a"]] == \
+            pytest.approx(2.5e6)
+        man = doc["otherData"]["merged"]
+        assert [s["offset_s"] for s in man] == [0.0, 2.5]
+
+    def test_merge_accepts_sidecar_shards(self, tmp_path):
+        obs.enable()
+        obs.event("child.target", qor=2.0)
+        sc = str(tmp_path / "sc.jsonl")
+        sidecar.dump(sc)
+        a = _make_shard(tmp_path, "a.json", "driver",
+                        obs.trace_origin_unix(), [
+                            {"ph": "i", "pid": 1, "tid": 1, "name": "e",
+                             "ts": 0.0, "s": "t"}])
+        doc = merge.merge_shards([merge.load_shard(a),
+                                  merge.load_shard(sc)])
+        obs.validate_trace(doc)
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert any(n.startswith("worker-child") for n in names)
+
+    def test_client_server_joins_annotated(self, tmp_path):
+        cli = _make_shard(tmp_path, "c.json", "client", 1000.0, [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "client.request",
+             "ts": 0.0, "dur": 5000.0, "args": {"ctx": "abc-1",
+                                                "op": "ask"}}])
+        srv = _make_shard(tmp_path, "s.json", "server", 1000.0, [
+            {"ph": "X", "pid": 1, "tid": 1, "name": "serve.handle",
+             "ts": 1000.0, "dur": 2000.0, "args": {"parent": "abc-1",
+                                                   "op": "ask"}}])
+        doc = merge.merge_shards([merge.load_shard(cli),
+                                  merge.load_shard(srv)])
+        assert doc["otherData"]["joins"] == 1
+        req = next(e for e in doc["traceEvents"]
+                   if e.get("name") == "client.request")
+        assert req["args"]["server_ms"] == 2.0
+        assert req["args"]["wire_ms"] == 3.0
+
+    def test_cli_merge_and_validate(self, tmp_path, capsys):
+        a = _make_shard(tmp_path, "a.json", "p1", 1.0, [])
+        out = str(tmp_path / "m.json")
+        assert merge.main(["merge", "-o", out, a]) == 0
+        assert merge.main(["validate", out]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"traceEvents": [{"ph": "X"}]}')
+        assert merge.main(["validate", str(bad)]) == 1
+        assert merge.main(["merge", "-o", out,
+                           str(tmp_path / "missing.json")]) == 1
+
+    def test_committed_merged_artifact_is_valid(self):
+        """ISSUE 10 acceptance: the checked-in merged example (bench.py
+        --obs phase 4) spans >= 3 distinct processes — driver, worker
+        child, serve server/client — passes validate_trace, and has at
+        least one annotated client/server join."""
+        path = os.path.join(REPO, "exp_archives",
+                            "obs_trace_merged_example.json")
+        with open(path) as f:
+            doc = json.load(f)
+        obs.validate_trace(doc)
+        procs = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert len(procs) >= 3
+        roles = {p.split()[0] for p in procs}
+        assert {"ut-driver", "ut-serve", "ut-client"} <= roles
+        assert any(r.startswith("worker-child") for r in roles)
+        assert doc["otherData"]["joins"] >= 1
+        joined = [e for e in doc["traceEvents"]
+                  if e.get("name") == "client.request"
+                  and "wire_ms" in e.get("args", {})]
+        assert joined
+
+
+# ----------------------------------------------------------------- top
+class TestTop:
+    def _rows(self):
+        return [
+            {"t": 100.0, "dt": 1.0, "counters": {"serve.asks": 50},
+             "deltas": {"serve.asks": 50}, "gauges": {}, "hists": {}},
+            {"t": 101.0, "dt": 1.0,
+             "counters": {"serve.asks": 175, "store.hits": 30,
+                          "store.misses": 10},
+             "deltas": {"serve.asks": 125, "store.hits": 30,
+                        "store.misses": 10},
+             "gauges": {"serve.sessions.active": 12,
+                        "serve.batch_fill": 0.875,
+                        "pool.utilization": 0.66},
+             "hists": {"serve.ask_ms": {"count": 175, "p50": 0.4,
+                                        "p95": 1.2}}},
+        ]
+
+    def test_render_shows_vitals_and_rates(self):
+        r1, r2 = (top.sample_from_row(r) for r in self._rows())
+        frame = top.render(r1, r2, "test-source")
+        assert "test-source" in frame
+        assert "sessions 12" in frame
+        assert "batch fill 0.88" in frame
+        assert "asks/s 125.0" in frame          # deltas/dt, exact
+        assert "ask p50/p95 0.40/1.20 ms" in frame
+        assert "hit-rate 75.0%" in frame
+
+    def test_render_missing_families_degrade_to_dash(self):
+        cur = top.Sample(100.0, {}, {}, {})
+        frame = top.render(None, cur, "empty")
+        assert "—" in frame                     # never a KeyError
+
+    def test_rates_fall_back_to_poll_diffs(self):
+        p = top.Sample(100.0, {"serve.asks": 10}, {}, {})
+        c = top.Sample(102.0, {"serve.asks": 30}, {}, {})
+        assert top.rates(p, c)["serve.asks"] == pytest.approx(10.0)
+        assert top.rates(None, c) == {}
+
+    def test_once_over_metrics_file(self, tmp_path, capsys):
+        path = tmp_path / "m.jsonl"
+        path.write_text("\n".join(json.dumps(r) for r in self._rows())
+                        + "\n{\"torn")
+        assert top.main(["--metrics", str(path), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "asks/s 125.0" in out
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert top.main(["--metrics", str(empty), "--once"]) == 1
+
+    def test_ut_cli_dispatches_top(self):
+        from uptune_tpu import cli
+        with pytest.raises(SystemExit) as e:
+            cli.main(["top", "--help"])
+        assert e.value.code == 0
+
+
+# ------------------------------------------------------------ flushing
+class TestExitFlush:
+    def test_flush_all_writes_trace_and_final_row(self, tmp_path):
+        """Tier-1 sibling of the @slow SIGINT e2e: the registered
+        flush writes a valid trace + stops the recorder, tagging the
+        reason; re-entry is guarded."""
+        obs.enable()
+        trace = str(tmp_path / "t.json")
+        obs.install_exit_flush(trace, extra={"process": "test"})
+        obs.start_flight_recorder(trace, interval=60)
+        obs.count("x")
+        obs._flush_all("signal:2")
+        doc = json.load(open(trace))
+        obs.validate_trace(doc)
+        assert doc["otherData"]["flushed_on"] == "signal:2"
+        assert doc["otherData"]["process"] == "test"
+        rows = [json.loads(l) for l in open(trace + ".metrics.jsonl")]
+        assert rows[-1]["final"] is True
+
+
+# ------------------------------------------------------- slow e2e pair
+@pytest.mark.slow
+class TestSubprocessE2E:
+    PROG = textwrap.dedent("""
+        import uptune_tpu as ut
+        x = ut.tune(50, (0, 100), name="x")
+        y = ut.tune(50, (0, 100), name="y")
+        ut.target(float((x - 37) ** 2 + (y - 11) ** 2), "min")
+    """)
+
+    def test_child_sidecar_spans_merge_onto_worker_lane(self, tmp_path):
+        """Real subprocess trials: the traced driver's worker lanes
+        carry the children's own child.* spans, clock-aligned inside
+        their pool.build windows, and the consumed sidecars are gone
+        (tier-1 sibling: TestSidecar.test_dump_read_roundtrip...)."""
+        from uptune_tpu.exec.controller import ProgramTuner
+        prog = tmp_path / "prog.py"
+        prog.write_text(self.PROG)
+        obs.enable()
+        pt = ProgramTuner([sys.executable, str(prog)], str(tmp_path),
+                          parallel=1, prefetch=0, test_limit=3, seed=0,
+                          store_dir="off", env=ENV, runtime_limit=60.0)
+        pt.run()
+        evs = obs.snapshot()["events"]
+        child = [e for e in evs if e["name"].startswith("child.")]
+        assert {e["track"] for e in child} == {"worker-0"}
+        assert {"child.run", "child.target",
+                "child.load_proposal"} <= {e["name"] for e in child}
+        builds = {(e["attrs"] or {}).get("gid"): e for e in evs
+                  if e["name"] == "pool.build"}
+        for e in child:
+            b = builds[(e["attrs"] or {}).get("gid")]
+            assert b["ts"] - 0.1 <= e["ts"] <= b["ts"] + b["dur"] + 0.1
+        # every sidecar was consumed at reap
+        temp = tmp_path / "ut.temp"
+        assert not list(temp.glob("temp.*/" + sidecar.SIDECAR_FILE))
+        from uptune_tpu.obs import metrics as m
+        assert m.snapshot()["counters"]["pool.sidecar_events"] >= 3
+
+    def test_sigint_flushes_truncated_telemetry(self, tmp_path):
+        """An interrupted `ut` run (the satellite): SIGINT mid-tune
+        still leaves a validate_trace-clean trace and a metrics
+        timeline ending in a final row (tier-1 sibling:
+        TestExitFlush)."""
+        prog = tmp_path / "prog.py"
+        prog.write_text(self.PROG)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        env.pop("UT_TRACE_GUARD", None)
+        p = subprocess.Popen(
+            [sys.executable, "-m", "uptune_tpu.cli", str(prog),
+             "--test-limit", "500", "-pf", "1", "--store", "off",
+             "--trace", "t.json", "--metrics-interval", "0.2"],
+            cwd=str(tmp_path), env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        deadline = time.time() + 300
+        archive = tmp_path / "ut.archive.jsonl"
+        while time.time() < deadline and not archive.exists():
+            time.sleep(0.3)
+        assert archive.exists(), "tune never got under way"
+        time.sleep(1.0)
+        p.send_signal(signal.SIGINT)
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode != 0            # it WAS interrupted
+        doc = json.load(open(tmp_path / "t.json"))
+        obs.validate_trace(doc)
+        assert doc["otherData"]["flushed_on"] in (
+            "signal:2", "atexit"), out
+        rows = [json.loads(l)
+                for l in open(tmp_path / "t.json.metrics.jsonl")]
+        assert rows and rows[-1]["final"] is True

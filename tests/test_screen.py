@@ -155,10 +155,17 @@ class TestManagerIntegration:
         with pytest.raises(ValueError, match="different space"):
             archive_rows(other, arch)
 
+    @pytest.mark.slow
     def test_screened_beats_unscreened_ranking(self):
         """On a mostly-dead space with 48 observations, the screened
         GP's posterior mean must rank a large candidate set better
-        than the unscreened one (the whole point of the transfer)."""
+        than the unscreened one (the whole point of the transfer).
+        Slow-marked for suite-budget headroom (ISSUE 10, ~15 s — the
+        two full-width fit_auto sweeps dominate): the screen mechanics
+        keep tier-1 coverage via the manager-integration and
+        soft-screen tests in this file, and the measured
+        screened-vs-unscreened claim is pinned on gcc-real in
+        BENCHREPORT.md."""
         from uptune_tpu.surrogate import gp as gp_mod
 
         space = _space(n_float=4, n_bool=24, n_enum=6)

@@ -339,6 +339,78 @@ class TestServerProtocol:
                     h.tell(t.ticket, _measure(t.config))
 
 
+class TestDistributedObs:
+    """ISSUE 10 serve-plane halves: trace-context propagation over the
+    wire and the Prometheus scrape format."""
+
+    def test_prometheus_scrape_format(self, server):
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=31, store=False) as h:
+                for t in h.ask(2):
+                    h.tell(t.ticket, _measure(t.config))
+            m = c.metrics(format="prometheus")
+        text = m["metrics_text"]
+        assert "metrics" not in m          # text replaces the snapshot
+        assert "# TYPE ut_serve_asks counter" in text
+        assert "ut_serve_sessions_active" in text
+        # histogram summaries: quantile series + _sum/_count
+        assert 'ut_serve_ask_ms{quantile="0.5"}' in text
+        assert "ut_serve_ask_ms_count" in text
+        r = server.handle({"op": "metrics", "format": "nope"})
+        assert r["ok"] is False and "format" in r["error"]
+
+    def test_ctx_joins_client_and_handler_spans(self, server):
+        """A traced client's requests carry ctx span ids; the server's
+        serve.handle spans carry them back as `parent` — the join
+        `ut-trace merge` annotates.  In-process here, so both sides
+        land in one ring set and the pairing is directly assertable."""
+        from uptune_tpu import obs
+        if not obs.enabled():
+            obs.enable()
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=32, store=False) as h:
+                for t in h.ask(2):
+                    h.tell(t.ticket, _measure(t.config))
+        evs = obs.snapshot()["events"]
+        ctxs = {(e["attrs"] or {}).get("ctx") for e in evs
+                if e["name"] == "client.request"}
+        pairs = [(e["attrs"] or {}) for e in evs
+                 if e["name"] == "serve.handle"
+                 and (e["attrs"] or {}).get("parent")]
+        assert ctxs and pairs
+        assert {p["parent"] for p in pairs} <= ctxs
+        # ops are tagged on both sides of the join
+        assert {p["op"] for p in pairs} >= {"open", "ask", "tell"}
+
+    def test_untraced_client_sends_no_ctx(self, server):
+        """The wire stays minimal for untraced clients: no ctx field
+        leaves the process (asserted at the payload level)."""
+        from uptune_tpu import obs
+        was = obs.enabled()
+        obs.disable()
+        try:
+            captured = {}
+            real = json.dumps
+
+            def spy(payload, **kw):
+                if isinstance(payload, dict) and "op" in payload:
+                    captured.setdefault(payload["op"], payload)
+                return real(payload, **kw)
+
+            with connect(("127.0.0.1", server.port)) as c:
+                import uptune_tpu.serve.client as mod
+                old = mod.json.dumps
+                mod.json.dumps = spy
+                try:
+                    c.ping()
+                finally:
+                    mod.json.dumps = old
+            assert "ctx" not in captured["ping"]
+        finally:
+            if was:
+                obs.enable()
+
+
 class TestIsolationParity:
     SEEDS = (101, 202, 303, 404)
 
@@ -506,10 +578,17 @@ class TestNoRetrace:
 
 
 class TestBenchSmoke:
+    @pytest.mark.slow
     def test_serve_bench_quick_smoke(self, tmp_path):
         """`bench.py --serve --quick` keeps producing its evidence
         JSON: concurrent multiplexed sessions, both sequential
-        baselines, and a clean strict retrace report."""
+        baselines, and a clean strict retrace report.  Slow-marked for
+        suite-budget headroom (ISSUE 10, the ~34 s heaviest tier-1
+        item; same rule as the PR 7 `--surrogate --quick` slow-mark):
+        the serving plane keeps dense tier-1 coverage above — TCP e2e,
+        isolation+parity, memo sharing, strict no-retrace churn — and
+        the full bench runs out-of-band like every other BENCH_*
+        artifact."""
         env = {**os.environ, "UT_TRACE_GUARD": "strict",
                "JAX_PLATFORMS": "cpu"}
         r = subprocess.run(

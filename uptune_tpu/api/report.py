@@ -63,6 +63,11 @@ def target(val: Any, objective: str = "min") -> Any:
         STATE.cur_stage += 1
         STATE.count = 0
     elif mode == TUNE:
+        # lands in the trial's trace sidecar (when the driver traces):
+        # the moment the user program produced its QoR, visible inside
+        # the slot's build window after the reap-time merge
+        from .. import obs
+        obs.event("child.target", qor=qor, stage=STATE.cur_stage)
         n_stages = (len(STATE.params_meta) if STATE.params_meta
                     else max(1, len(STATE.recorded)))
         if n_stages <= 1:

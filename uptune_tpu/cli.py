@@ -179,12 +179,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="unified observability plane "
                         "(docs/OBSERVABILITY.md): record cross-plane "
                         "spans (ticket lifecycle, worker-slot build "
-                        "lanes, background refit, store hits) and "
-                        "write a Perfetto-viewable Chrome trace JSON "
-                        "here, plus OUT.json.metrics.jsonl with the "
-                        "run's counters/gauges/histograms.  Also "
-                        "reachable via UT_TRACE=<path> or "
-                        "ut.config({'trace': ...}); 'off' disables")
+                        "lanes + their subprocess sidecar spans, "
+                        "background refit, store hits) and write a "
+                        "Perfetto-viewable Chrome trace JSON here, "
+                        "plus OUT.json.metrics.jsonl with the flight "
+                        "recorder's periodic metrics timeline.  The "
+                        "trace and metrics tail are also flushed on "
+                        "SIGINT/SIGTERM, so an interrupted run keeps "
+                        "its telemetry.  Also reachable via "
+                        "UT_TRACE=<path> or ut.config({'trace': ...}); "
+                        "'off' disables")
+    p.add_argument("--metrics-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="flight-recorder cadence for the traced run's "
+                        "metrics timeline (default 1.0; 0 disables "
+                        "the background thread and restores the "
+                        "single end-of-run metrics snapshot).  Only "
+                        "meaningful with --trace/UT_TRACE")
     p.add_argument("--device", choices=("cpu", "accel"), default="cpu",
                    help="platform for the search engine (default cpu: "
                         "black-box evals dominate; 'accel' trusts the "
@@ -333,15 +344,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         # (docs/SERVING.md) has its own flag set and precedence layer
         from .serve.cli import main as serve_main
         return serve_main(raw[1:])
-    if raw and raw[0].startswith("-") and "serve" == next(
-            (a for a in raw if not a.startswith("-")), None):
-        # `ut -v serve` falls through and tries to TUNE a program
-        # file literally named "serve".  A hint only — never abort:
-        # "serve" here may legitimately be a flag VALUE (arity is the
-        # parser's business), and the tuning parser's own error
-        # follows if it really was a misplaced subcommand
-        print("[ut] hint: to start the session server, 'serve' must "
-              "come first: ut serve [flags]", file=sys.stderr)
+    if raw and raw[0] == "top":
+        # `ut top ...`: live terminal dashboard over a running server
+        # (or a flight-recorder metrics JSONL) — docs/OBSERVABILITY.md
+        from .obs.top import main as top_main
+        return top_main(raw[1:])
+    first_pos = next((a for a in raw if not a.startswith("-")), None) \
+        if raw and raw[0].startswith("-") else None
+    if first_pos in ("serve", "top"):
+        # `ut -v serve` / `ut -v top` fall through and try to TUNE a
+        # program file literally named like the subcommand.  A hint
+        # only — never abort: the word may legitimately be a flag
+        # VALUE (arity is the parser's business), and the tuning
+        # parser's own error follows if it really was a misplaced
+        # subcommand
+        print(f"[ut] hint: the {first_pos!r} subcommand must come "
+              f"first: ut {first_pos} [flags]", file=sys.stderr)
     args = build_parser().parse_args(argv)
     _configure_logging(args.verbose)
     log = logging.getLogger("uptune_tpu")
@@ -518,6 +536,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         trace_path = f"{root}.h{pid_env}{ext}"
     if trace_path and not obs.enabled():
         obs.enable()
+    if trace_path:
+        # graceful telemetry (docs/OBSERVABILITY.md): a ^C'd or
+        # SIGTERM'd run still flushes a valid truncated trace + the
+        # metrics timeline's tail; the flight recorder turns the
+        # end-of-run metrics snapshot into a periodic timeline
+        obs.install_exit_flush(trace_path, extra={"process": "ut-driver"})
+        mi = (args.metrics_interval if args.metrics_interval is not None
+              else 1.0)
+        if mi > 0:
+            obs.start_flight_recorder(trace_path, interval=mi)
 
     from .analysis.trace_guard import guard_from_env
     from .exec.multistage import run_auto
@@ -530,8 +558,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the trace-guard retrace report ships INSIDE the obs export
         # (and every individual trace is already an instant event on
         # the timeline) instead of as a separate stderr report
-        extra = ({"trace_guard": guard.report()} if guard.enabled
-                 else None)
+        extra = {"process": "ut-driver"}
+        if guard.enabled:
+            extra["trace_guard"] = guard.report()
         if trace_path:
             obs.finish(trace_path, extra=extra)
             log.info("[ut] trace written to %s (open in "

@@ -26,6 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import obs
+from ..obs import sidecar
 from .measure import _preexec, kill_process_group
 
 PROTOCOL_FILES = ("ut.params.json",)   # copied (not symlinked) per sandbox
@@ -182,10 +183,11 @@ class WorkerPool:
             raise RuntimeError("no free worker slot")
         slot = free[0]
         sb = slot.sandbox
-        # clear stale protocol outputs
+        # clear stale protocol outputs (incl. a previous trial's trace
+        # sidecar: a reused slot must never replay old child spans)
         for name in os.listdir(sb):
-            if name.startswith("ut.qor_stage") or name == \
-                    "ut.features.json":
+            if name.startswith("ut.qor_stage") or name in (
+                    "ut.features.json", sidecar.SIDECAR_FILE):
                 os.unlink(os.path.join(sb, name))
         cfg_path = os.path.join(
             sb, "configs", f"ut.dr_stage{stage}_index{slot.index}.json")
@@ -203,6 +205,15 @@ class WorkerPool:
             "UT_WORK_DIR": sb,
         })
         env.pop("UT_BEFORE_RUN_PROFILE", None)
+        # trace-context propagation (docs/OBSERVABILITY.md): when the
+        # driver traces, the child records its own spans and dumps them
+        # to a per-sandbox sidecar merged back at reap.  Pop first so a
+        # stale path from an enclosing traced run never leaks into an
+        # untraced child (it would dump into a foreign sandbox)
+        env.pop(sidecar.SIDECAR_ENV, None)
+        if obs.enabled():
+            env[sidecar.SIDECAR_ENV] = os.path.join(
+                sb, sidecar.SIDECAR_FILE)
         if self.pre_launch is not None:
             self.pre_launch(sb, slot.index, trial)
         slot.log_f = open(os.path.join(sb, "ut.run.log"), "w")
@@ -258,10 +269,17 @@ class WorkerPool:
         # span stays entirely on the perf_counter timebase (t0p) — the
         # wall-clock `dur` above can go negative across an NTP step
         pdur = time.perf_counter() - slot.t0p
+        lane = f"worker-{self.slot_prefix}{slot.index}"
         obs.complete_span(
-            "pool.build", t0=slot.t0p, dur=pdur,
-            track=f"worker-{self.slot_prefix}{slot.index}",
+            "pool.build", t0=slot.t0p, dur=pdur, track=lane,
             gid=getattr(trial, "gid", None), rc=rc, timeout=killed)
+        # child-side sidecar spans nest inside the build window on the
+        # same lane (clock-offset aligned; killed children usually had
+        # no atexit, so an absent file is routine)
+        n_child = sidecar.merge_into(
+            os.path.join(slot.sandbox, sidecar.SIDECAR_FILE), lane)
+        if n_child:
+            obs.count("pool.sidecar_events", n_child)
         obs.observe("pool.build_s", pdur)
         obs.gauge("pool.utilization", self.utilization())
         if killed:

@@ -27,21 +27,26 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
+from . import flight
 from .core import (DEFAULT_CAPACITY, complete_span, device_span,
-                   disable, enable, enabled, event, now, reset,
-                   snapshot, span, trace_origin_unix)
-from .export import (chrome_trace, text_summary, validate_trace,
-                     write_metrics_jsonl, write_trace)
+                   disable, emit_at, enable, enabled, event,
+                   new_span_id, now, reset, snapshot, span,
+                   trace_origin_unix)
+from .export import (chrome_trace, prometheus_text, text_summary,
+                     validate_trace, write_metrics_jsonl, write_trace)
 from .metrics import count, counter_value, gauge, observe
 from .metrics import snapshot as metrics_snapshot
+from .metrics import window_snapshot
 
 __all__ = [
     "enabled", "enable", "disable", "reset", "span", "device_span",
-    "event", "complete_span", "count", "gauge", "observe", "snapshot",
-    "metrics_snapshot", "chrome_trace", "write_trace",
-    "write_metrics_jsonl", "text_summary", "validate_trace", "now",
-    "trace_origin_unix", "maybe_enable_from_env", "finish",
-    "instrument_device_fn", "DEFAULT_CAPACITY",
+    "event", "complete_span", "emit_at", "new_span_id", "count",
+    "gauge", "observe", "snapshot", "metrics_snapshot",
+    "window_snapshot", "chrome_trace", "write_trace",
+    "write_metrics_jsonl", "prometheus_text", "text_summary",
+    "validate_trace", "now", "trace_origin_unix",
+    "maybe_enable_from_env", "finish", "start_flight_recorder",
+    "install_exit_flush", "instrument_device_fn", "DEFAULT_CAPACITY",
 ]
 
 
@@ -84,16 +89,115 @@ def maybe_enable_from_env(env: Optional[dict] = None) -> Optional[str]:
 def finish(path: Optional[str],
            extra: Optional[Dict[str, Any]] = None,
            metrics_path: Optional[str] = None) -> Optional[dict]:
-    """End-of-run export: write the Chrome trace to `path`, append one
-    metrics-snapshot line next to it (`<path>.metrics.jsonl` unless
-    `metrics_path` overrides), and return the trace document.  A None
-    path skips the files (summary-only callers).  Recording stays
-    enabled — callers own disable()/reset()."""
+    """End-of-run export: write the Chrome trace to `path` and settle
+    the metrics sidecar next to it (`<path>.metrics.jsonl` unless
+    `metrics_path` overrides) — when a flight recorder is running on
+    that sidecar it is stopped (writing its final timeline row);
+    otherwise one legacy metrics-snapshot line is appended.  Returns
+    the trace document.  A None path skips the files (summary-only
+    callers).  Recording stays enabled — callers own
+    disable()/reset()."""
     if not enabled():
         return None
     doc = None
     if path:
         doc = write_trace(path, extra=extra)
-        write_metrics_jsonl(metrics_path or path + ".metrics.jsonl",
-                            extra={"trace": os.path.basename(path)})
+        mpath = metrics_path or path + ".metrics.jsonl"
+        rec = flight.active_for(mpath)
+        if rec is not None:
+            rec.stop()
+        elif not flight.had_recorder(mpath):
+            write_metrics_jsonl(mpath,
+                                extra={"trace": os.path.basename(path)})
+        # this path is settled: the exit-flush hook must not overwrite
+        # the document (it would drop caller extras like the
+        # trace-guard report written on the clean path)
+        _FLUSH_REGISTRY.pop(path, None)
     return doc
+
+
+def start_flight_recorder(trace_path: str,
+                          interval: float = flight.DEFAULT_INTERVAL,
+                          metrics_path: Optional[str] = None,
+                          max_rows: int = flight.DEFAULT_MAX_ROWS
+                          ) -> "flight.FlightRecorder":
+    """Start the periodic metrics timeline for a traced run, on the
+    same `<trace>.metrics.jsonl` sidecar `finish()` settles (so the
+    one-shot scrape becomes a timeline, not a second file).  `ut top
+    --metrics <sidecar>` tails it live; `interval <= 0` is rejected by
+    the caller layer ('off')."""
+    return flight.start(metrics_path or trace_path + ".metrics.jsonl",
+                        interval=interval, max_rows=max_rows,
+                        extra={"trace": os.path.basename(trace_path)})
+
+
+# ------------------------------------------------------- exit flushing
+# a run interrupted by ^C (or a supervisor's SIGTERM) must still leave
+# a valid — merely truncated — trace and a metrics tail on disk.  The
+# registry maps trace path -> extra dict; one set of hooks flushes all.
+_FLUSH_REGISTRY: Dict[str, Dict[str, Any]] = {}
+_FLUSH_STATE: Dict[str, Any] = {"hooked": False, "flushing": False,
+                                "reason": None}
+
+
+def _flush_all(reason: str) -> None:
+    if _FLUSH_STATE["flushing"]:
+        return              # re-entrant call during a flush
+    _FLUSH_STATE["flushing"] = True
+    try:
+        for path, extra in list(_FLUSH_REGISTRY.items()):
+            try:
+                finish(path, extra={**extra, "flushed_on": reason})
+            except OSError:
+                pass        # output dir vanished: nothing to save to
+    finally:
+        _FLUSH_STATE["flushing"] = False
+
+
+def _flush_atexit() -> None:
+    _flush_all(_FLUSH_STATE["reason"] or "atexit")
+
+
+def install_exit_flush(path: str,
+                       extra: Optional[Dict[str, Any]] = None) -> None:
+    """Register `path` for graceful telemetry flushing: the trace (and
+    the flight recorder's final row) is written at interpreter exit,
+    not only on the clean end-of-run `finish()` path — including exits
+    forced by SIGINT/SIGTERM.  The signal handlers themselves do NO
+    I/O and take NO locks: a Python signal handler runs on the main
+    thread between bytecodes, possibly inside a frame that already
+    holds the (non-reentrant) metrics/ring locks the flush needs, so
+    flushing inline could deadlock the very ^C it serves.  Instead the
+    handler records the reason and unwinds (KeyboardInterrupt /
+    SystemExit), and the atexit hook — running after the stack, and
+    therefore every lock, is released — performs the actual flush,
+    tagged with the recorded signal.  Handlers chain to whatever was
+    installed before (default SIGINT behavior is preserved);
+    installation is skipped silently off the main thread, where Python
+    forbids signal handlers.  Idempotent per path."""
+    import atexit
+    import signal
+    import sys
+
+    _FLUSH_REGISTRY[path] = dict(extra or {})
+    if _FLUSH_STATE["hooked"]:
+        return
+    _FLUSH_STATE["hooked"] = True
+    atexit.register(_flush_atexit)
+
+    def _chain(sig, prev):
+        def handler(signum, frame):
+            _FLUSH_STATE["reason"] = f"signal:{signum}"
+            if callable(prev):
+                prev(signum, frame)
+            elif signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            else:
+                sys.exit(128 + signum)
+        return handler
+
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            signal.signal(sig, _chain(sig, signal.getsignal(sig)))
+        except (ValueError, OSError):
+            pass            # non-main thread / unsupported platform

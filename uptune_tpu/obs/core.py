@@ -36,14 +36,16 @@ Records are plain tuples ``(name, ts, dur, track, attrs)``:
 """
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 __all__ = [
     "enabled", "enable", "disable", "reset", "span", "device_span",
-    "event", "complete_span", "snapshot", "trace_origin_unix",
-    "DEFAULT_CAPACITY",
+    "event", "complete_span", "emit_at", "new_span_id", "snapshot",
+    "trace_origin_unix", "DEFAULT_CAPACITY",
 ]
 
 DEFAULT_CAPACITY = 1 << 16
@@ -251,6 +253,32 @@ def complete_span(name: str, t0: float, dur: float,
     if not _ENABLED:
         return
     _record((name, t0 - _T0, max(0.0, dur), track, attrs or None))
+
+
+def emit_at(name: str, ts: float, dur: Optional[float] = None,
+            track: Optional[str] = None,
+            attrs: Optional[Dict[str, Any]] = None) -> None:
+    """Record an event/span at an EXPLICIT trace-relative timestamp
+    (seconds since this process's trace origin) — the foreign-clock
+    entry point: a subprocess sidecar's events are re-emitted here
+    after their unix-clock offset against our origin is applied
+    (`obs.sidecar.merge_into`).  `dur=None` records an instant."""
+    if not _ENABLED:
+        return
+    _record((name, float(ts),
+             None if dur is None else max(0.0, float(dur)),
+             track, attrs or None))
+
+
+# span/trace ids for cross-process context propagation: unique within
+# a process by the counter, across processes by the pid prefix (good
+# enough to join one client's request span to one server handler span
+# in a merged trace — not a cryptographic trace id)
+_SPAN_SEQ = itertools.count(1)
+
+
+def new_span_id() -> str:
+    return f"{os.getpid():x}-{next(_SPAN_SEQ):x}"
 
 
 # ------------------------------------------------------------- reading

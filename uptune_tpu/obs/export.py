@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 from . import core, metrics
 
 __all__ = ["chrome_trace", "write_trace", "write_metrics_jsonl",
-           "text_summary", "validate_trace"]
+           "text_summary", "validate_trace", "prometheus_text"]
 
 PID = 1
 
@@ -116,8 +116,11 @@ def write_metrics_jsonl(path: str,
 def validate_trace(doc: Any) -> None:
     """Schema contract for the exported trace (raises ValueError):
     every event has ph/pid/tid/name; X events carry numeric ts and
-    dur >= 0; instants carry ts; every tid used by a timed event has a
-    thread_name metadata record."""
+    dur >= 0; instants carry ts; every (pid, tid) used by a timed
+    event has a thread_name metadata record.  Lanes are keyed by the
+    (pid, tid) PAIR — tids are per-process in the Chrome format, so a
+    merged multi-process document (`ut-trace merge`) legitimately
+    reuses tid 1 under every pid."""
     def fail(msg):
         raise ValueError(f"trace schema: {msg}")
 
@@ -126,8 +129,8 @@ def validate_trace(doc: Any) -> None:
     evs = doc["traceEvents"]
     if not isinstance(evs, list):
         fail("'traceEvents' must be a list")
-    named_tids = set()
-    used_tids = set()
+    named_lanes = set()
+    used_lanes = set()
     for i, e in enumerate(evs):
         if not isinstance(e, dict):
             fail(f"event {i} is not an object")
@@ -138,13 +141,13 @@ def validate_trace(doc: Any) -> None:
             if e["name"] == "thread_name":
                 if not e.get("args", {}).get("name"):
                     fail(f"event {i}: thread_name without args.name")
-                named_tids.add(e["tid"])
+                named_lanes.add((e["pid"], e["tid"]))
             continue
         if e["ph"] not in ("X", "i", "C"):
             fail(f"event {i}: unknown phase {e['ph']!r}")
         if not isinstance(e.get("ts"), (int, float)):
             fail(f"event {i}: non-numeric ts")
-        used_tids.add(e["tid"])
+        used_lanes.add((e["pid"], e["tid"]))
         if e["ph"] == "X":
             d = e.get("dur")
             if not isinstance(d, (int, float)) or d < 0:
@@ -154,10 +157,54 @@ def validate_trace(doc: Any) -> None:
                 json.dumps(e["args"])
             except (TypeError, ValueError):
                 fail(f"event {i}: args not JSON-serializable")
-    missing = used_tids - named_tids
+    missing = used_lanes - named_lanes
     if missing:
-        fail(f"tids {sorted(missing)} have events but no thread_name "
-             f"metadata (lanes would be anonymous in Perfetto)")
+        fail(f"lanes {sorted(missing)} have events but no thread_name "
+             f"metadata (they would be anonymous in Perfetto)")
+
+
+def _prom_name(name: str) -> str:
+    """Metric-registry name -> Prometheus metric name: dots and every
+    other illegal character become underscores, one `ut_` namespace
+    prefix."""
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isascii() and (ch.isalnum() or ch == "_"))
+                   else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return "ut_" + s
+
+
+def prometheus_text(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text exposition (version 0.0.4) of a metrics
+    snapshot: counters as `counter`, gauges as `gauge`, histogram
+    summaries as `summary` (quantile series + `_sum`/`_count`).  The
+    serve `{"op": "metrics", "format": "prometheus"}` scrape returns
+    this string so a textfile-collector / sidecar exporter can relay
+    the registry without learning the JSON schema."""
+    if snap is None:
+        snap = metrics.snapshot()
+    lines: List[str] = []
+    for k in sorted(snap.get("counters", {})):
+        n = _prom_name(k)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {snap['counters'][k]:g}")
+    for k in sorted(snap.get("gauges", {})):
+        n = _prom_name(k)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {snap['gauges'][k]:g}")
+    for k in sorted(snap.get("hists", {})):
+        h = snap["hists"][k]
+        n = _prom_name(k)
+        lines.append(f"# TYPE {n} summary")
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            if h.get(key) is not None:
+                lines.append(f'{n}{{quantile="{q}"}} {h[key]:g}')
+        lines.append(f"{n}_sum {h.get('sum', 0):g}")
+        lines.append(f"{n}_count {h.get('count', 0):g}")
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def text_summary(snap: Optional[Dict[str, Any]] = None) -> str:

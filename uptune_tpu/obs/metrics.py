@@ -29,8 +29,8 @@ from typing import Any, Dict, List, Optional
 
 from . import core
 
-__all__ = ["count", "gauge", "observe", "snapshot", "reset",
-           "counter_value"]
+__all__ = ["count", "gauge", "observe", "snapshot", "window_snapshot",
+           "reset", "counter_value"]
 
 _LOCK = threading.Lock()
 _COUNTERS: Dict[str, float] = {}
@@ -115,6 +115,53 @@ def snapshot() -> Dict[str, Any]:
             "gauges": dict(_GAUGES),
             "hists": {k: h.summary() for k, h in _HISTS.items()},
         }
+
+
+def window_snapshot(cursor: Optional[Dict[str, Any]] = None):
+    """One flight-recorder row: the absolute scrape PLUS what changed
+    since `cursor` (the previous call's second return value).
+
+    Returns ``(row, new_cursor)`` where ``row`` is
+    ``{"counters": abs, "deltas": {name: since-cursor}, "gauges": abs,
+    "hists": {name: window summary}}``.  A histogram's window summary
+    reports ``count``/``sum`` for the whole run and
+    ``window_count``/``window_sum``/``p50``/``p95`` over ONLY the
+    samples recorded since the cursor — so a long-lived server's
+    timeline shows each interval's latency distribution, not an
+    ever-flattening lifetime percentile.  (Past `_HIST_CAP` retained
+    samples the window percentiles go None while the window counts
+    stay exact — same honesty rule as `_Hist.summary`.)
+
+    Everything is read under the one metrics lock, so a row is a
+    consistent cut: the writer thread and a concurrent scrape can
+    never disagree about which update landed in which window."""
+    prev_c = (cursor or {}).get("counters", {})
+    prev_h = (cursor or {}).get("hists", {})
+    with _LOCK:
+        counters = dict(_COUNTERS)
+        gauges = dict(_GAUGES)
+        hists: Dict[str, Any] = {}
+        hcur: Dict[str, Any] = {}
+        for k, h in _HISTS.items():
+            pn, psum, plen = prev_h.get(k, (0, 0.0, 0))
+            win = h.samples[plen:]
+            summ: Dict[str, Any] = {
+                "count": h.n, "sum": round(h.total, 6),
+                "window_count": h.n - pn,
+                "window_sum": round(h.total - psum, 6),
+            }
+            if win:
+                s = sorted(win)
+                for p in (50, 95):
+                    summ[f"p{p}"] = round(
+                        s[min(len(s) - 1, (len(s) * p) // 100)], 6)
+            hists[k] = summ
+            hcur[k] = (h.n, h.total, len(h.samples))
+    deltas = {k: round(v - prev_c.get(k, 0), 6)
+              for k, v in counters.items()}
+    row = {"counters": counters, "deltas": deltas, "gauges": gauges,
+           "hists": hists}
+    return row, {"counters": counters, "hists": hcur}
 
 
 def reset() -> None:

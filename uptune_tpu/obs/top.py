@@ -1,0 +1,261 @@
+"""`ut top` — live terminal dashboard for a running tuning process.
+
+Two data sources, one view:
+
+* ``ut top --addr host:port`` polls a running `ut serve` process's
+  ``{"op": "metrics"}`` scrape over the wire (rates are computed from
+  counter deltas between successive polls);
+* ``ut top --metrics out.json.metrics.jsonl`` tails a flight-recorder
+  timeline on disk (any traced run: `ut serve`, `ut prog.py --trace`,
+  bench.py) — rows already carry per-window deltas, so rates read
+  straight off the newest row.  Works on a LIVE file and post-mortem
+  on a crashed run's tail alike.
+
+The frame shows the serving plane's vitals: active sessions, epoch
+batch fill, ask/tell rates and latency percentiles, worker-pool
+utilization, store hit rate, and surrogate refit lag — the numbers an
+operator needs before pod-scale work lands (ROADMAP items 1 and 3).
+Every field is pulled defensively: a metrics stream missing a family
+(a driver run has no `serve.*`) renders "—", never a crash.
+
+``--once`` prints a single frame and exits (scripts, tests); the
+refresh loop redraws with ANSI cursor-home + clear and exits cleanly
+on ^C / a vanished server.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Sample", "rates", "render", "main"]
+
+CLEAR = "\x1b[H\x1b[2J"
+
+
+class Sample:
+    """One metrics observation: absolute counters/gauges/hists at time
+    `t`, plus (for flight-recorder rows) the row's own window deltas."""
+
+    def __init__(self, t: float, counters: Dict[str, float],
+                 gauges: Dict[str, float], hists: Dict[str, Any],
+                 deltas: Optional[Dict[str, float]] = None,
+                 dt: Optional[float] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.t = t
+        self.counters = counters
+        self.gauges = gauges
+        self.hists = hists
+        self.deltas = deltas
+        self.dt = dt
+        self.meta = dict(meta or {})
+
+
+def sample_from_scrape(resp: Dict[str, Any]) -> Sample:
+    """A serve `{"op": "metrics"}` response -> Sample."""
+    m = resp.get("metrics", {}) or {}
+    return Sample(time.time(), m.get("counters", {}) or {},
+                  m.get("gauges", {}) or {}, m.get("hists", {}) or {},
+                  meta={"sessions": resp.get("sessions"),
+                        "uptime_s": resp.get("uptime_s")})
+
+
+def sample_from_row(row: Dict[str, Any]) -> Sample:
+    """A flight-recorder JSONL row -> Sample."""
+    return Sample(float(row.get("t", 0.0)),
+                  row.get("counters", {}) or {},
+                  row.get("gauges", {}) or {},
+                  row.get("hists", {}) or {},
+                  deltas=row.get("deltas"), dt=row.get("dt"),
+                  meta={"final": row.get("final", False),
+                        "trace": row.get("trace")})
+
+
+TAIL_BYTES = 256 * 1024
+
+
+def last_rows(path: str, n: int = 2) -> List[Dict[str, Any]]:
+    """The last `n` parseable rows of a metrics JSONL (tail-tolerant:
+    a row being appended right now is skipped).  Reads only the final
+    `TAIL_BYTES` of the file — a rotation-capped timeline near 20k
+    rows is megabytes, and the refresh loop calls this every couple
+    of seconds; the first (possibly truncated) line of a mid-file
+    seek fails to parse and is skipped like any torn row."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - TAIL_BYTES))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return []
+    out: List[Dict[str, Any]] = []
+    for line in reversed(lines):
+        if len(out) >= n:
+            break
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict) and "counters" in row:
+            out.append(row)
+    return list(reversed(out))
+
+
+def rates(prev: Optional[Sample], cur: Sample) -> Dict[str, float]:
+    """Per-second counter rates for the displayed window.  Prefers the
+    row's own deltas (flight-recorder source, exact window); falls
+    back to diffing successive polls (scrape source)."""
+    if cur.deltas is not None and cur.dt:
+        return {k: v / cur.dt for k, v in cur.deltas.items()}
+    if prev is None or cur.t <= prev.t:
+        return {}
+    dt = cur.t - prev.t
+    return {k: (v - prev.counters.get(k, 0)) / dt
+            for k, v in cur.counters.items()}
+
+
+def _fmt(v: Any, unit: str = "", nd: int = 1) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:,.{nd}f}{unit}"
+    return f"{v}{unit}"
+
+
+def _hist_p(hists: Dict[str, Any], name: str, p: str) -> Optional[float]:
+    h = hists.get(name)
+    return h.get(p) if isinstance(h, dict) else None
+
+
+def render(prev: Optional[Sample], cur: Sample, source: str,
+           width: int = 78) -> str:
+    """One dashboard frame as text (pure: testable without a tty)."""
+    r = rates(prev, cur)
+    c, g, h = cur.counters, cur.gauges, cur.hists
+    hits = c.get("store.hits", 0)
+    misses = c.get("store.misses", 0)
+    hit_rate = (hits / (hits + misses) if hits + misses else None)
+    up = cur.meta.get("uptime_s")
+    lines = [
+        f"ut top — {source}"[:width],
+        time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(cur.t))
+        + (f"   up {up:,.0f}s" if up is not None else "")
+        + (f"   window {cur.dt:.2f}s" if cur.dt else "")
+        + ("   [FINAL]" if cur.meta.get("final") else ""),
+        "-" * min(width, 60),
+        "serve     sessions {}   batch fill {}   groups+ {}".format(
+            _fmt(g.get("serve.sessions.active"), nd=0),
+            _fmt(g.get("serve.batch_fill"), nd=2),
+            _fmt(c.get("serve.groups_created"), nd=0)),
+        "rates     asks/s {}   tells/s {}   proposes/s {}   "
+        "store-served/s {}".format(
+            _fmt(r.get("serve.asks", r.get("driver.asks"))),
+            _fmt(r.get("serve.tells", r.get("driver.told"))),
+            _fmt(r.get("serve.proposes")),
+            _fmt(r.get("serve.store_served"))),
+        "latency   ask p50/p95 {}/{} ms   tell p50/p95 {}/{} ms".format(
+            _fmt(_hist_p(h, "serve.ask_ms", "p50"), nd=2),
+            _fmt(_hist_p(h, "serve.ask_ms", "p95"), nd=2),
+            _fmt(_hist_p(h, "serve.tell_ms", "p50"), nd=2),
+            _fmt(_hist_p(h, "serve.tell_ms", "p95"), nd=2)),
+        "workers   busy {}   utilization {}   builds/s {}   "
+        "build p95 {} s".format(
+            _fmt(g.get("pool.busy"), nd=0),
+            _fmt(g.get("pool.utilization"), nd=2),
+            _fmt(r.get("pool.launched")),
+            _fmt(_hist_p(h, "pool.build_s", "p95"), nd=2)),
+        "store     hits {}   misses {}   hit-rate {}   "
+        "serve p95 {} ms".format(
+            _fmt(hits, nd=0), _fmt(misses, nd=0),
+            _fmt(None if hit_rate is None else 100 * hit_rate, "%"),
+            _fmt(_hist_p(h, "store.serve_ms", "p95"), nd=2)),
+        "learn     snapshot v{}   refit lag {} rows   "
+        "new bests {}".format(
+            _fmt(g.get("surrogate.snapshot_version"), nd=0),
+            _fmt(g.get("surrogate.refit_lag_rows"), nd=0),
+            _fmt(c.get("serve.new_bests", c.get("driver.new_bests")),
+                 nd=0)),
+    ]
+    # anything moving that the fixed panel doesn't show (top deltas)
+    shown = {"serve.asks", "serve.tells", "serve.proposes",
+             "serve.store_served", "driver.asks", "driver.told",
+             "pool.launched"}
+    extras = sorted(((v, k) for k, v in r.items()
+                     if v > 0 and k not in shown), reverse=True)[:4]
+    if extras:
+        lines.append("also      " + "   ".join(
+            f"{k} {_fmt(v)}/s" for v, k in extras)[:width - 10])
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------ CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ut top",
+        description="live dashboard over a running uptune-tpu server "
+                    "or a flight-recorder metrics timeline "
+                    "(docs/OBSERVABILITY.md)")
+    src = p.add_mutually_exclusive_group()
+    src.add_argument("--addr", default=None, metavar="HOST:PORT",
+                     help="poll a running `ut serve` process's "
+                          "metrics op (default: the configured "
+                          "serve-host:serve-port)")
+    src.add_argument("--metrics", default=None, metavar="JSONL",
+                     help="tail a flight-recorder metrics timeline "
+                          "instead of polling a server")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh cadence in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (scripts/tests)")
+    args = p.parse_args(argv)
+
+    client = None
+    prev: Optional[Sample] = None
+
+    def poll() -> Tuple[Optional[Sample], str]:
+        nonlocal client
+        if args.metrics:
+            rows = last_rows(args.metrics, 2)
+            if not rows:
+                return None, args.metrics
+            return sample_from_row(rows[-1]), args.metrics
+        from ..serve.client import connect
+        if client is None:
+            client = connect(args.addr)
+        resp = client.metrics()
+        return (sample_from_scrape(resp),
+                f"{client.host}:{client.port}")
+
+    try:
+        while True:
+            try:
+                cur, source = poll()
+            except (OSError, ValueError, RuntimeError) as e:
+                print(f"ut top: {e}", file=sys.stderr)
+                return 1
+            if cur is None:
+                print(f"ut top: no metrics rows yet in {source}",
+                      file=sys.stderr)
+                if args.once:
+                    return 1
+            else:
+                frame = render(prev, cur, source)
+                if args.once:
+                    print(frame)
+                    return 0
+                sys.stdout.write(CLEAR + frame + "\n")
+                sys.stdout.flush()
+                prev = cur
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if client is not None:
+            client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,7 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base dir for the default store location")
     p.add_argument("--trace", default=None, metavar="OUT.json",
                    help="observability export written at shutdown "
-                        "(docs/OBSERVABILITY.md); 'off' disables")
+                        "(docs/OBSERVABILITY.md); 'off' disables. "
+                        "Flushed on SIGINT/SIGTERM too, and the "
+                        "metrics sidecar is a flight-recorder "
+                        "timeline while the server runs")
+    p.add_argument("--metrics-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="flight-recorder cadence for the traced "
+                        "server's metrics timeline (default 1.0; 0 "
+                        "disables).  `ut top --metrics "
+                        "OUT.json.metrics.jsonl` tails it live")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -94,6 +103,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         trace_path = None
     if trace_path and not obs.enabled():
         obs.enable()
+    if trace_path:
+        # a serving process is exactly the shape the flight recorder
+        # exists for: long-lived, scraped rarely, killed by signal —
+        # without the timeline + exit flush it leaves no telemetry
+        obs.install_exit_flush(trace_path,
+                               extra={"process": "ut-serve"})
+        mi = (args.metrics_interval if args.metrics_interval is not None
+              else 1.0)
+        if mi > 0:
+            obs.start_flight_recorder(trace_path, interval=mi)
 
     from .server import SessionServer
     srv = SessionServer(**resolve_config(args))
@@ -101,7 +120,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         srv.serve_forever()
     finally:
         if trace_path:
-            obs.finish(trace_path)
+            obs.finish(trace_path, extra={"process": "ut-serve"})
             log.info("[ut-serve] trace written to %s", trace_path)
         elif obs.enabled():
             snap = obs.metrics_snapshot()

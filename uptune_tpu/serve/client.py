@@ -23,7 +23,10 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Union
+
+from .. import obs
 
 
 class ServeError(RuntimeError):
@@ -68,9 +71,23 @@ class SessionClient:
     # -- wire ----------------------------------------------------------
     def request(self, op: str, **fields: Any) -> Dict[str, Any]:
         """One synchronous request/response; raises ServeError on
-        ok=False."""
+        ok=False.
+
+        Trace-context propagation (docs/OBSERVABILITY.md): when THIS
+        process is tracing, the request carries a ``ctx`` span id and
+        the round trip is recorded as a ``client.request`` span tagged
+        with it; the server's matching ``serve.handle`` span carries
+        the same id as ``parent``, so `ut-trace merge` joins the two
+        shards and decomposes client-observed latency into wire vs
+        server time.  Untraced clients send no extra field."""
         payload = {"op": op, **{k: v for k, v in fields.items()
                                 if v is not None}}
+        sid = None
+        t0 = 0.0
+        if obs.enabled():
+            sid = obs.new_span_id()
+            payload["ctx"] = {"span": sid}
+            t0 = time.perf_counter()
         with self._lock:
             # a request that died mid-exchange (socket timeout,
             # KeyboardInterrupt out of readline) leaves its response
@@ -89,6 +106,11 @@ class SessionClient:
             except BaseException:
                 self._broken = True
                 raise
+        if sid is not None:
+            obs.complete_span("client.request", t0=t0,
+                              dur=time.perf_counter() - t0,
+                              op=op, ctx=sid,
+                              server=f"{self.host}:{self.port}")
         if not line:
             raise ServeError(f"server {self.host}:{self.port} closed "
                              f"the connection")
@@ -101,10 +123,12 @@ class SessionClient:
     def ping(self) -> Dict[str, Any]:
         return self.request("ping")
 
-    def metrics(self) -> Dict[str, Any]:
+    def metrics(self, format: Optional[str] = None) -> Dict[str, Any]:
         """The server's obs metrics scrape (counters / gauges /
-        histogram summaries — docs/OBSERVABILITY.md names)."""
-        return self.request("metrics")
+        histogram summaries — docs/OBSERVABILITY.md names).
+        ``format="prometheus"`` returns the text exposition in
+        ``metrics_text`` instead of the JSON snapshot."""
+        return self.request("metrics", format=format)
 
     def stats(self) -> Dict[str, Any]:
         return self.request("stats")

@@ -310,10 +310,22 @@ class SessionServer:
 
     def _op_metrics(self, req: dict) -> dict:
         """The obs-plane scrape (PR 7 left this seam open: metrics
-        snapshot() was written as the future session-server payload)."""
-        return {"metrics": obs.metrics_snapshot(),
-                "sessions": self.n_sessions,
-                "uptime_s": round(time.time() - self.started_unix, 3)}
+        snapshot() was written as the future session-server payload).
+        ``"format": "prometheus"`` returns the text exposition instead
+        (docs/SERVING.md), so a textfile collector / sidecar exporter
+        can relay the registry without learning the JSON schema."""
+        fmt = str(req.get("format", "json")).lower()
+        out: Dict[str, Any] = {
+            "sessions": self.n_sessions,
+            "uptime_s": round(time.time() - self.started_unix, 3)}
+        if fmt == "prometheus":
+            out["metrics_text"] = obs.prometheus_text()
+        elif fmt == "json":
+            out["metrics"] = obs.metrics_snapshot()
+        else:
+            raise RequestError(
+                f"metrics format must be json|prometheus: {fmt!r}")
+        return out
 
     def _op_stats(self, req: dict) -> dict:
         with self._lock:
@@ -335,12 +347,19 @@ class SessionServer:
 
     def handle(self, req: Any) -> dict:
         """Transport-free dispatch: one request dict -> one response
-        dict (never raises; errors come back as ok=False)."""
+        dict (never raises; errors come back as ok=False).
+
+        An optional ``ctx`` object (``{"span": id}``) is the client's
+        trace context: the handler span records it as ``parent``, so
+        a merged client+server trace joins each ``client.request``
+        span to the ``serve.handle`` span it paid for — wire time is
+        the difference (docs/OBSERVABILITY.md)."""
         if not isinstance(req, dict):
             return {"ok": False, "error": "request must be a JSON "
                                           "object"}
         rid = req.get("id")
         op = req.get("op")
+        ctx = req.get("ctx")
         # an unhashable op (list/dict) must hit the unknown-op reply,
         # not TypeError out of the dict lookup before the error wall
         fn = self._OPS.get(op) if isinstance(op, str) else None
@@ -349,15 +368,21 @@ class SessionServer:
                    "error": f"unknown op {op!r}; valid: "
                             f"{sorted(self._OPS)}"}
         else:
-            try:
-                out = {"ok": True, **fn(self, req)}
-            except RequestError as e:
-                out = {"ok": False, "error": str(e)}
-            except Exception as e:   # defensive: a tenant must not
-                # be able to take the serving loop down
-                log.exception("[ut-serve] %s failed", op)
-                out = {"ok": False,
-                       "error": f"internal: {type(e).__name__}: {e}"}
+            attrs = {"op": op}
+            if isinstance(ctx, dict) and ctx.get("span") is not None:
+                attrs["parent"] = str(ctx["span"])[:64]
+            with obs.span("serve.handle", **attrs) as sp:
+                try:
+                    out = {"ok": True, **fn(self, req)}
+                except RequestError as e:
+                    out = {"ok": False, "error": str(e)}
+                    sp.set(error=True)
+                except Exception as e:   # defensive: a tenant must not
+                    # be able to take the serving loop down
+                    log.exception("[ut-serve] %s failed", op)
+                    out = {"ok": False,
+                           "error": f"internal: {type(e).__name__}: {e}"}
+                    sp.set(error=True)
         if rid is not None:
             out["id"] = rid
         return out
