@@ -1,0 +1,521 @@
+"""Search-quality observability (ISSUE 12): the tuning journal, the
+online QualityMonitor and its exact offline replay, the stall /
+miscalibration / failure detectors, the serve health op, `ut report`
+rendering, `ut top --json`, and the committed example artifacts.
+
+The acceptance spine: (1) online convergence/calibration gauges equal
+an exact offline recomputation from the journal of the same
+matched-seed run; (2) alerts fire on a synthetic stalled tune and a
+deliberately miswired surrogate and stay silent on a healthy
+rosenbrock run; (3) the committed example report renders from the
+committed journal.  The tiny driver e2e here is the fast tier-1
+sibling of the slow-marked `bench.py --report --quick` subprocess
+smoke.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from uptune_tpu import obs
+from uptune_tpu.obs import journal, quality
+from uptune_tpu.obs import report as obs_report
+from uptune_tpu.obs.quality import QualityConfig, SessionQuality
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    yield
+    journal.stop()
+    obs.reset()
+
+
+# ---------------------------------------------------------------- journal
+class TestJournal:
+    def test_disabled_is_noop(self, tmp_path):
+        assert not journal.enabled()
+        journal.emit("tell", gid=0)       # must not raise or write
+        assert journal.path() is None
+
+    def test_round_trip_header_and_rows(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        journal.start(p, meta={"k": "v"})
+        journal.emit("snapshot", version=1, n_rows=8, bucket=16)
+        journal.emit("step", step=1, arm="de", evaluated=2,
+                     new_best=True, best=1.0, evals=2, src="technique",
+                     batch=8, trials=2, dup=6, filtered=0, gids=[0, 1],
+                     ok=[True, True], qors=[1.0, 2.0],
+                     nb=[True, False], durs=[0.1, 0.1])
+        journal.stop()
+        header, rows = journal.read(p, strict=True)
+        assert header["journal"] == journal.SCHEMA_VERSION
+        assert header["meta"] == {"k": "v"}
+        assert [r["ev"] for r in rows] == ["snapshot", "step"]
+        assert rows[1]["qors"] == [1.0, 2.0] and rows[1]["t"] >= 0
+
+    def test_torn_tail_tolerated_lenient_rejected_strict(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        journal.start(p)
+        journal.emit("store_hit", gid=0, qor=1.0, dur=0.0)
+        journal.stop()
+        with open(p, "a") as f:
+            f.write('{"ev": "store_hit", "gid": 1')  # torn final line
+        header, rows = journal.read(p)
+        assert len(rows) == 1
+        # final-line tears are legal even in strict mode (crashed
+        # writer); a mid-stream tear is not
+        _, rows2 = journal.read(p, strict=True)
+        assert len(rows2) == 1
+
+    def test_strict_rejects_unknown_kind(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        journal.start(p)
+        journal.emit("store_hit", gid=0, qor=1.0, dur=0.0)
+        journal.stop()
+        with open(p, "a") as f:
+            f.write(json.dumps({"ev": "martian", "t": 0.0}) + "\n")
+        with pytest.raises(ValueError, match="martian"):
+            journal.read(p, strict=True)
+
+    def test_sink_sees_rows_before_serialization(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        seen = []
+        journal.add_sink(seen.append)
+        try:
+            journal.start(p)
+            journal.emit("store_hit", gid=7, qor=1.0, dur=0.0)
+        finally:
+            journal.remove_sink(seen.append)
+        assert seen and seen[0]["gid"] == 7
+
+    def test_buffered_rows_flush_on_stop(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        journal.start(p)
+        for i in range(10):      # below the flush threshold
+            journal.emit("store_hit", gid=i, qor=1.0, dur=0.0)
+        journal.stop()
+        _, rows = journal.read(p, strict=True)
+        assert len(rows) == 10
+
+
+# ------------------------------------------------------- quality monitor
+def _step(i, qor, best, new_best, ok=True, mu=None, sigma=None, **kw):
+    """One synthetic single-trial step row (the journal packs per-trial
+    outcomes as arrays on the ticket's step row)."""
+    row = {"ev": "step", "t": float(i), "step": i, "arm": "de",
+           "evaluated": 1, "withdrawn": False, "new_best": new_best,
+           "best": best, "evals": i + 1, "gids": [i], "ok": [ok],
+           "qors": [qor if ok else None], "nb": [new_best],
+           "durs": [0.0], **kw}
+    if mu is not None:
+        row["mus"], row["sigmas"] = [mu], [sigma]
+    return row
+
+
+class TestQualityMonitor:
+    def test_calibration_math_exact(self):
+        # four joined rows with hand-checkable moments
+        rows = [
+            _step(0, 1.0, 1.0, True, mu=1.5, sigma=1.0),   # z = -0.5
+            _step(1, 2.0, 1.0, False, mu=2.0, sigma=1.0),  # z = 0
+            _step(2, 4.0, 1.0, False, mu=1.0, sigma=1.0),  # z = 3
+            _step(3, 3.0, 1.0, False, mu=2.5, sigma=0.2),  # z = 2.5
+        ]
+        mon = quality.replay(rows)
+        g = mon.gauges
+        assert g["search.cal_rows"] == 4
+        assert g["search.cal_mae"] == round((0.5 + 0 + 3 + 0.5) / 4, 6)
+        assert g["search.cal_cover95"] == 0.5   # |z|<=1.96: rows 0, 1
+        assert g["search.cal_cover50"] == 0.5
+        # mus [1.5, 2, 1, 2.5] vs qors [1, 2, 4, 3]: imperfect ranking
+        assert -1.0 <= g["search.cal_rank_corr"] < 1.0
+        assert g["search.best_qor"] == 1.0
+        assert g["search.tells_since_best"] == 3
+
+    def test_stall_alert_fires_once_and_rearms(self):
+        cfg = QualityConfig(stall_tells=5)
+        rows = [_step(i, 2.0, 1.0, False) for i in range(8)]
+        rows += [_step(8, 0.5, 0.5, True)]
+        rows += [_step(9 + i, 2.0, 0.5, False) for i in range(6)]
+        mon = quality.replay(rows, cfg)
+        kinds = [a["kind"] for a in mon.alerts]
+        assert kinds == ["stall", "stall"]      # one per episode
+        assert mon.alerts[0]["tells_since_best"] == 5
+
+    def test_miscalibration_alert_on_miswired_surrogate(self):
+        # deliberately miswired: confident (sigma ~ 0) and wrong —
+        # interval coverage collapses, the detector must fire
+        cfg = QualityConfig(min_cal_rows=10)
+        rows = [_step(i, float(i % 7), 0.0, i == 0,
+                      mu=100.0, sigma=1e-6) for i in range(12)]
+        mon = quality.replay(rows, cfg)
+        kinds = [a["kind"] for a in mon.alerts]
+        assert "miscalibration" in kinds
+        assert mon.gauges["search.cal_cover95"] == 0.0
+
+    def test_uselessly_wide_intervals_alert(self):
+        # sigma ~1e9 wider than the actual error: coverage is perfect
+        # but the intervals rank nothing — the median-|z| floor fires
+        cfg = QualityConfig(min_cal_rows=10)
+        rows = [_step(i, float(i % 7), 0.0, i == 0,
+                      mu=3.0, sigma=1e9) for i in range(12)]
+        mon = quality.replay(rows, cfg)
+        assert any(a["kind"] == "miscalibration" for a in mon.alerts)
+        assert mon.gauges["search.cal_cover50"] == 1.0
+        assert mon.gauges["search.cal_med_abs_z"] < 1e-6
+
+    def test_accurate_but_conservative_model_is_not_flagged(self):
+        # honest accuracy with generous sigma: coverage ~100% yet the
+        # errors are a meaningful fraction of the interval — healthy
+        cfg = QualityConfig(min_cal_rows=10)
+        rows = [_step(i, float(i % 7), 0.0, i == 0,
+                      mu=float(i % 7) + 0.2, sigma=1.0)
+                for i in range(12)]
+        mon = quality.replay(rows, cfg)
+        assert mon.alerts == []
+
+    def test_failure_rate_alert(self):
+        cfg = QualityConfig(fail_window=8, fail_rate_hi=0.5)
+        rows = [_step(i, None, None, False, ok=False)
+                for i in range(8)]
+        mon = quality.replay(rows, cfg)
+        assert [a["kind"] for a in mon.alerts] == ["failures"]
+        assert mon.gauges["search.fail_rate"] == 1.0
+
+    def test_healthy_stream_stays_silent(self):
+        rows = []
+        best = 10.0
+        for i in range(60):
+            q = 10.0 - 0.15 * i
+            nb = q < best
+            best = min(best, q)
+            rows.append(_step(i, q, best, nb, mu=q + 0.1, sigma=1.0))
+        mon = quality.replay(rows)
+        assert mon.alerts == []
+        assert mon.gauges["search.cal_cover95"] == 1.0
+
+    def test_pull_and_arm_rates(self):
+        rows = [
+            {"ev": "step", "t": 1.0, "step": 1, "arm": "de",
+             "evaluated": 4, "withdrawn": False, "new_best": True,
+             "best": 1.0, "evals": 4, "src": "technique", "batch": 8,
+             "trials": 4, "pruned": 2, "filtered": 0, "dup": 2},
+            {"ev": "step", "t": 2.0, "step": 2, "arm": "pso",
+             "evaluated": 4, "withdrawn": False, "new_best": False,
+             "best": 1.0, "evals": 8, "src": "technique", "batch": 8,
+             "trials": 4, "pruned": 2, "filtered": 0, "dup": 2},
+            {"ev": "store_hit", "t": 3.0, "gid": 9, "qor": 1.0,
+             "dur": 2.0},
+        ]
+        mon = quality.replay(rows)
+        g = mon.gauges
+        assert g["search.pulls"] == 2
+        assert g["search.dup_rate"] == 0.25
+        assert g["search.prune_rate"] == 0.25
+        assert g["search.novel_rate"] == 0.5
+        assert g["search.arm_evals_share.de"] == 0.5
+        assert g["search.arm_best_share.de"] == 1.0
+
+    def test_replay_survives_json_round_trip(self, tmp_path):
+        p = str(tmp_path / "j.jsonl")
+        mon = obs.start_journal(p)
+        best = 5.0
+        for i in range(40):
+            q = 5.0 - 0.04 * i * (i % 3)
+            nb = q < best
+            best = min(best, q)
+            journal.emit("step", step=i, arm="de", evaluated=1,
+                         withdrawn=False, new_best=nb,
+                         best=round(best, 6), evals=i + 1,
+                         gids=[i], ok=[True], qors=[round(q, 6)],
+                         nb=[nb], durs=[0.0],
+                         mus=[round(q + 0.3, 6)], sigmas=[0.7])
+        obs.stop_journal(mon)
+        _, rows = journal.read(p, strict=True)
+        assert quality.replay(rows).gauges == mon.gauges
+
+
+# ---------------------------------------------------- driver e2e (tier-1)
+@pytest.fixture(scope="module")
+def driver_journal(tmp_path_factory):
+    """One tiny matched-seed journaled tune shared by the e2e asserts:
+    rosenbrock-2d, sync GP surrogate (deterministic), obs + journal on
+    — the fast sibling of the slow bench.py --report smoke."""
+    from uptune_tpu.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_objective, \
+        rosenbrock_space
+    p = str(tmp_path_factory.mktemp("journal") / "run.journal.jsonl")
+    obs.enable()
+    mon = obs.start_journal(p, meta={"test": "driver_journal"})
+    t = Tuner(rosenbrock_space(2, -2.048, 2.048),
+              rosenbrock_objective(2), seed=0, surrogate="gp",
+              surrogate_opts=dict(min_points=8, refit_interval=16,
+                                  max_points=64, async_refit=False))
+    t.run(test_limit=60)
+    t.close()
+    journal.flush()
+    obs.stop_journal(mon)   # detaches + finalizes the cadence gauges
+    online = dict(mon.gauges)
+    metrics_gauges = obs.metrics_snapshot()["gauges"]
+    alerts = list(mon.alerts)
+    obs.reset()
+    yield {"path": p, "online": online, "alerts": alerts,
+           "metrics_gauges": metrics_gauges}
+
+
+class TestDriverJournal:
+    def test_online_gauges_match_offline_replay(self, driver_journal):
+        """ISSUE 12 acceptance: the online gauges equal an EXACT
+        offline recomputation from the journal file."""
+        _, rows = journal.read(driver_journal["path"], strict=True)
+        replayed = quality.replay(rows)
+        assert replayed.gauges == driver_journal["online"]
+        # and the published copies in the metrics registry agree
+        pub = {k: v for k, v in driver_journal["metrics_gauges"].items()
+               if k.startswith("search.")}
+        assert pub == {k: v for k, v in replayed.gauges.items()
+                       if k in pub}
+        assert pub      # non-empty: publication actually happened
+
+    def test_row_schema_and_calibration_join(self, driver_journal):
+        _, rows = journal.read(driver_journal["path"], strict=True)
+        kinds = {r["ev"] for r in rows}
+        assert {"step", "snapshot"} <= kinds
+        steps = [r for r in rows if r["ev"] == "step"]
+        assert all({"arm", "evaluated", "new_best", "best",
+                    "evals"} <= set(r) for r in steps)
+        evaluated = [r for r in steps if r.get("qors")]
+        assert evaluated
+        for r in evaluated:
+            n = len(r["qors"])
+            # compact encoding: exactly one gid form; optional arrays
+            # (ok/nb/durs at their defaults are omitted) match length
+            assert ("gid0" in r) != ("gids" in r)
+            for k in ("gids", "ok", "nb", "durs"):
+                if k in r:
+                    assert len(r[k]) == n
+        # the GP fits at 8 points -> later steps carry mus/sigmas
+        joined = [r for r in evaluated if "mus" in r]
+        assert joined and all(
+            len(r["mus"]) == len(r["sigmas"]) == len(r["qors"])
+            and "pred_v" in r for r in joined)
+        # pull verdicts ride the step rows (captured at ticket open)
+        pulls = [r for r in steps if "batch" in r]
+        assert pulls and all(
+            r["src"] in ("technique", "surrogate", "injected",
+                         "random")
+            and r["batch"] >= r["trials"] + r["dup"] + r["pruned"]
+            + r["filtered"] - 1 for r in pulls)
+
+    def test_healthy_run_is_alert_free(self, driver_journal):
+        """Acceptance: detectors stay silent on a healthy rosenbrock
+        run (while the synthetic stalled/miswired streams above
+        fire)."""
+        assert driver_journal["alerts"] == []
+
+    def test_report_renders_from_live_journal(self, driver_journal,
+                                              tmp_path):
+        html = obs_report.render(driver_journal["path"])
+        assert "<svg" in html and "Calibration reliability" in html
+        md = obs_report.render(driver_journal["path"], fmt="md")
+        assert "## Arm attribution" in md
+        # CLI surface: ut report -> file
+        out = str(tmp_path / "r.html")
+        assert obs_report.main([driver_journal["path"],
+                                "-o", out]) == 0
+        assert os.path.getsize(out) > 1000
+
+
+# -------------------------------------------------------- serve health
+class TestServeHealth:
+    def _server(self):
+        from uptune_tpu.serve.server import SessionServer
+        return SessionServer(port=0, slots=4, store_dir="off")
+
+    def _open(self, srv, seed=0):
+        from uptune_tpu.exec.space_io import records_from_space
+        from uptune_tpu.workloads import rosenbrock_space
+        recs = records_from_space(rosenbrock_space(2, -3.0, 3.0))
+        resp = srv.handle({"op": "open", "space": recs, "seed": seed})
+        assert resp["ok"], resp
+        return resp["session"]
+
+    def test_health_op_per_session_and_rollup(self):
+        srv = self._server()
+        try:
+            sid = self._open(srv)
+            resp = srv.handle({"op": "health", "session": sid})
+            assert resp["ok"] and resp["health"]["status"] == "cold"
+            # drive tells: first improves, the rest stall
+            qor = 1.0
+            for _ in range(12):
+                trials = srv.handle({"op": "ask", "session": sid,
+                                     "n": 2})["trials"]
+                for t in trials:
+                    srv.handle({"op": "tell", "session": sid,
+                                "ticket": t["ticket"], "qor": qor})
+                    qor += 1.0          # strictly worse: no new best
+            one = srv.handle({"op": "health", "session": sid,
+                              "stall_tells": 8})["health"]
+            assert one["status"] == "stalled"
+            assert one["tells_since_best"] >= 8
+            assert one["best_qor"] == 1.0
+            ok = srv.handle({"op": "health", "session": sid})["health"]
+            assert ok["status"] == "ok"     # default threshold: quiet
+            roll = srv.handle({"op": "health", "stall_tells": 8})
+            assert roll["ok"] and roll["sessions"] == 1
+            assert roll["by_status"] == {"stalled": 1}
+            assert roll["health"][0]["session"] == sid
+        finally:
+            srv.stop()
+            obs.reset()
+
+    def test_failing_session_and_bad_threshold(self):
+        srv = self._server()
+        try:
+            sid = self._open(srv)
+            told = 0
+            while told < SessionQuality.FAIL_WINDOW:
+                trials = srv.handle({"op": "ask", "session": sid,
+                                     "n": 4})["trials"]
+                for t in trials:
+                    srv.handle({"op": "tell", "session": sid,
+                                "ticket": t["ticket"], "qor": None})
+                    told += 1
+            h = srv.handle({"op": "health", "session": sid})["health"]
+            assert h["status"] == "failing" and h["fail_rate"] == 1.0
+            bad = srv.handle({"op": "health", "stall_tells": "x"})
+            assert not bad["ok"]
+            unknown = srv.handle({"op": "health", "session": "nope"})
+            assert not unknown["ok"]
+        finally:
+            srv.stop()
+            obs.reset()
+
+    def test_local_session_health_and_journal_rows(self, tmp_path):
+        from uptune_tpu.serve.session import LocalSession
+        from uptune_tpu.workloads import rosenbrock_space
+        p = str(tmp_path / "serve.journal.jsonl")
+        mon = obs.start_journal(p)
+        with LocalSession(rosenbrock_space(2, -3.0, 3.0), seed=1) as s:
+            for _ in range(3):
+                for t in s.ask(2):
+                    s.tell(t.ticket, 1.25)
+            h = s.health()
+            assert h["status"] == "ok" and h["tells"] == 6
+        obs.stop_journal(mon)
+        _, rows = journal.read(p, strict=True)
+        st = [r for r in rows if r["ev"] == "serve_tell"]
+        assert len(st) == 6
+        assert all(r["ok"] and r["qor"] == 1.25 for r in st)
+        assert sum(r["new_best"] for r in st) == 1
+
+
+# ----------------------------------------------------------- ut top
+class TestTopJson:
+    def _row(self):
+        return {"t": 100.0, "dt": 1.0, "pid": 1,
+                "counters": {"driver.asks": 10, "search.alerts": 1},
+                "deltas": {"driver.asks": 5},
+                "gauges": {"search.best_qor": 1.5,
+                           "search.cal_cover95": 0.9},
+                "hists": {}}
+
+    def test_json_once_frame(self, tmp_path, capsys):
+        from uptune_tpu.obs import top
+        p = str(tmp_path / "m.jsonl")
+        with open(p, "w") as f:
+            f.write(json.dumps(self._row()) + "\n")
+        assert top.main(["--metrics", p, "--once", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["gauges"]["search.best_qor"] == 1.5
+        assert doc["rates"]["driver.asks"] == 5.0
+        assert doc["source"] == p
+
+    def test_json_requires_once(self, tmp_path):
+        from uptune_tpu.obs import top
+        with pytest.raises(SystemExit):
+            top.main(["--metrics", "x", "--json"])
+
+    def test_search_panel_renders(self):
+        from uptune_tpu.obs import top
+        cur = top.sample_from_row(self._row())
+        frame = top.render(None, cur, "test")
+        assert "search" in frame and "best 1.5" in frame
+        assert "cover95 0.90" in frame
+
+
+# ------------------------------------------------- committed artifacts
+class TestCommittedExamples:
+    JOURNAL = os.path.join(REPO, "exp_archives",
+                           "obs_journal_example.jsonl")
+    REPORT = os.path.join(REPO, "exp_archives",
+                          "obs_report_example.html")
+
+    def test_journal_example_schema_valid(self):
+        header, rows = journal.read(self.JOURNAL, strict=True)
+        assert header["journal"] == journal.SCHEMA_VERSION
+        steps = [r for r in rows if r["ev"] == "step"]
+        assert sum(len(r.get("qors") or ()) for r in steps) >= 100
+        assert any("mus" in r for r in steps)
+        mon = quality.replay(rows)
+        assert mon.alerts == []             # the example is healthy
+        assert mon.gauges["search.cal_rows"] > 0
+
+    def test_report_renders_from_committed_journal(self):
+        """Acceptance: the committed report is exactly what rendering
+        the committed journal produces (the renderer is deterministic
+        given the journal)."""
+        html = obs_report.render(self.JOURNAL)
+        with open(self.REPORT) as f:
+            committed = f.read()
+        assert html == committed
+        assert "<svg" in html and "No alerts fired." in html
+
+
+# ----------------------------------------------- pool reap journal rows
+class TestFeatureInterm:
+    def test_reap_reads_covars_and_interm(self, tmp_path):
+        from uptune_tpu.api.report import COVARS_FILE, FEATURES_FILE
+        from uptune_tpu.exec.pool import WorkerPool
+
+        class _FakeSlot:
+            sandbox = str(tmp_path)
+
+        class _FakeTrial:
+            gid = 42
+
+        with open(tmp_path / COVARS_FILE, "w") as f:
+            json.dump({"cores": 8}, f)
+        with open(tmp_path / FEATURES_FILE, "w") as f:
+            json.dump([[0, [1.0, 2.0]]], f)
+        p = str(tmp_path / "j.jsonl")
+        journal.start(p)
+        WorkerPool._journal_child_rows(_FakeSlot(), _FakeTrial())
+        journal.stop()
+        _, rows = journal.read(p, strict=True)
+        by = {r["ev"]: r for r in rows}
+        assert by["feature"]["covars"] == {"cores": 8}
+        assert by["feature"]["gid"] == 42
+        assert by["interm"]["feats"] == [1.0, 2.0]
+
+
+# --------------------------------------------------- slow e2e sibling
+@pytest.mark.slow
+def test_bench_report_smoke_subprocess():
+    """The heavy sibling: `python bench.py --report --quick` end to
+    end in a fresh process (its fast tier-1 siblings are the driver
+    e2e + render tests above)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--report",
+         "--quick"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO}, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert doc["value"] == 1.0 and doc["alerts"] == []

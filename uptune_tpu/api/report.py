@@ -118,6 +118,11 @@ def interm(features: Sequence[Any], shape: Optional[int] = None):
     elif mode == TUNE:
         with open(path, "w") as f:
             json.dump([[STATE.index, feats]], f)
+        # rides the trial's trace sidecar when the driver traces (like
+        # child.target); the persisted file above is what the reap path
+        # reads into the tuning journal (exec/pool.py, ISSUE 12)
+        from .. import obs
+        obs.event("child.interm", n=len(feats), stage=STATE.cur_stage)
         if os.environ.get("UT_MULTI_STAGE_SAMPLE"):
             sys.exit(0)  # 'pre'-phase breakpoint
     return features
@@ -140,6 +145,11 @@ def feature(val: Any, name: str) -> Any:
     data[name] = val
     with open(path, "w") as f:
         json.dump(data, f)
+    if STATE.mode == TUNE:
+        # sidecar visibility for traced runs; the journal row itself is
+        # emitted by the driver at reap from the file just written
+        from .. import obs
+        obs.event("child.feature", covar=str(name))
     return val
 
 

@@ -60,6 +60,15 @@ DEFAULTS: Dict[str, Any] = {
     # instrumented hot paths cost one flag check).  Layered under the
     # `ut --trace` flag and the UT_TRACE env var
     "trace": None,
+    # tuning journal (docs/OBSERVABILITY.md "Search-quality
+    # telemetry"): a path streams structured search events (arm pulls,
+    # dedup/prune verdicts, tells joined with the surrogate's
+    # propose-time mu/sigma, store hits) to an append-only JSONL and
+    # derives live convergence/calibration gauges + stall alerts from
+    # them; render post-hoc with `ut report`.  Layered under the
+    # `ut --journal` flag and the UT_JOURNAL env var; None/'off'
+    # leaves it disabled (one flag check per call site)
+    "journal": None,
     # async surrogate plane (docs/PERF.md): 'on' (None = default) moves
     # the O(N^3) GP refit + fit_auto hyperparameter sweep onto a
     # background worker publishing versioned snapshots, so the driver

@@ -189,6 +189,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "its telemetry.  Also reachable via "
                         "UT_TRACE=<path> or ut.config({'trace': ...}); "
                         "'off' disables")
+    p.add_argument("--journal", default=None, metavar="OUT.jsonl",
+                   help="tuning journal (docs/OBSERVABILITY.md "
+                        "'Search-quality telemetry'): an append-only "
+                        "JSONL stream of search decisions — arm pulls "
+                        "with dedup/prune verdicts, every tell joined "
+                        "with the surrogate's propose-time mu/sigma, "
+                        "store hits, snapshot publishes — plus live "
+                        "convergence/calibration gauges and stall/"
+                        "miscalibration alerts derived from it.  "
+                        "Render post-hoc with `ut report OUT.jsonl`.  "
+                        "Also reachable via UT_JOURNAL or "
+                        "ut.config({'journal': ...}); 'off' disables")
     p.add_argument("--metrics-interval", type=float, default=None,
                    metavar="SECONDS",
                    help="flight-recorder cadence for the traced run's "
@@ -349,9 +361,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # (or a flight-recorder metrics JSONL) — docs/OBSERVABILITY.md
         from .obs.top import main as top_main
         return top_main(raw[1:])
+    if raw and raw[0] == "report":
+        # `ut report ...`: render a tuning journal into a search-
+        # quality report (docs/OBSERVABILITY.md "Search-quality
+        # telemetry")
+        from .obs.report import main as report_main
+        return report_main(raw[1:])
     first_pos = next((a for a in raw if not a.startswith("-")), None) \
         if raw and raw[0].startswith("-") else None
-    if first_pos in ("serve", "top"):
+    if first_pos in ("serve", "top", "report"):
         # `ut -v serve` / `ut -v top` fall through and try to TUNE a
         # program file literally named like the subcommand.  A hint
         # only — never abort: the word may legitimately be a flag
@@ -547,6 +565,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         if mi > 0:
             obs.start_flight_recorder(trace_path, interval=mi)
 
+    # tuning journal (docs/OBSERVABILITY.md "Search-quality
+    # telemetry"): flag > UT_JOURNAL env > ut.config('journal').
+    # Resolved BEFORE starting so --num-hosts replicas suffix their
+    # path first (same .hN rule as the trace/archive files)
+    journal_path = args.journal
+    if journal_path is None:
+        journal_path = os.environ.get("UT_JOURNAL", "").strip() or None
+        if journal_path is None:
+            cfg_j = settings["journal"]
+            if cfg_j:
+                journal_path = str(cfg_j)
+    if journal_path and obs.journal.disabled_token(journal_path):
+        journal_path = None
+    if journal_path and pid_env and pid_env != "0":
+        root, ext = os.path.splitext(journal_path)
+        journal_path = f"{root}.h{pid_env}{ext}"
+    jmon = None
+    if journal_path:
+        jmon = obs.start_journal(
+            journal_path,
+            meta={"process": "ut-driver",
+                  "script": os.path.basename(script)})
+        if not trace_path:
+            # journal without trace: the graceful SIGINT/SIGTERM
+            # flush must still cover the journal's buffered tail
+            obs.install_exit_flush(None)
+
     from .analysis.trace_guard import guard_from_env
     from .exec.multistage import run_auto
     # UT_TRACE_GUARD=1|strict: count per-function jit traces over the
@@ -554,6 +599,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     # per technique, not once per step
     with guard_from_env() as guard:
         res = run_auto(pt)   # single / multi-stage / decouple dispatch
+    if journal_path:
+        # settle the journal BEFORE the trace export: detaching
+        # finalizes the quality gauges into the metrics registry, so
+        # the flight recorder's final row (written by obs.finish)
+        # carries the run's terminal search.* values even when the
+        # run was shorter than the publication cadence
+        for alert in (jmon.alerts if jmon is not None else []):
+            log.warning("[ut] search alert: %s", json.dumps(alert))
+        obs.stop_journal(jmon)
+        log.info("[ut] journal written to %s (render with "
+                 "`ut report %s`)", journal_path, journal_path)
     if obs.enabled():
         # the trace-guard retrace report ships INSIDE the obs export
         # (and every individual trace is already an instant event on

@@ -131,7 +131,8 @@ class _Ticket:
     __slots__ = ("arm", "arm_name", "tstate", "cands", "hashes", "known",
                  "src", "novel_np", "injected", "pruned", "trials",
                  "remaining", "u_np", "perms_np", "gen", "credit_virtual",
-                 "packed", "t_propose", "t_dedup", "t_open")
+                 "packed", "t_propose", "t_dedup", "t_open", "pred",
+                 "jpull")
 
     def __init__(self, arm, arm_name, tstate, cands, hashes, known, src,
                  novel_np, injected, pruned, gen=0, credit_virtual=False):
@@ -154,6 +155,14 @@ class _Ticket:
         self.u_np = None
         self.perms_np = None
         self.packed = None        # [B] uint64 packed hashes (host)
+        # journal calibration join (ISSUE 12): (mu [B], sd [B],
+        # snapshot version) recorded at propose time when the tuning
+        # journal is on and the surrogate is fitted; None otherwise
+        self.pred = None
+        # journal pull verdicts captured at ticket OPEN (src, batch,
+        # trials, pruned, filtered, dup) — emitted with the step row
+        # at finalize: one journal row per ticket, not two
+        self.jpull = None
         self.t_propose = 0.0      # s in the propose+dedup device call
         self.t_dedup = 0.0        # s in host-side mask + materialization
         self.t_open = 0.0         # perf_counter() when the ticket opened
@@ -879,6 +888,7 @@ class Tuner:
         """Materialize trials for a ticket's novel rows (after the
         optional ut.rule config filter) and register them pending."""
         tk.t_open = time.perf_counter()
+        f0 = self.filtered_total
         sp_obs = obs.span("ticket.dedup", arm=tk.arm_name)
         sp_obs.__enter__()
         try:
@@ -926,6 +936,98 @@ class Tuner:
         st = self.arm_stats.setdefault(tk.arm_name, [0, 0, 0])
         st[0] += 1
         st[1] += len(tk.trials)
+        if obs.journal.enabled():
+            self._journal_open(tk, self.filtered_total - f0)
+
+    def _journal_open(self, tk: _Ticket, filtered: int) -> None:
+        """Capture the pull verdicts (dedup / prune / filter counts)
+        and the surrogate's predictive moments for the proposed batch
+        AT PROPOSE TIME — the step row emitted at finalize carries
+        both, joining belief with outcome (ISSUE 12).  Only reached
+        when the journal is on: the extra predict dispatch and host
+        transfer never tax an unjournaled run."""
+        batch = int(tk.cands.batch)
+        trials = len(tk.trials)
+        src = ("surrogate" if tk.arm_name == "surrogate"
+               else "random" if tk.injected and tk.arm_name == "random"
+               else "injected" if tk.injected else "technique")
+        tk.jpull = (src, batch, trials, int(tk.pruned), int(filtered),
+                    max(0, batch - trials - int(tk.pruned)
+                        - int(filtered)))
+        sm = self.surrogate
+        if trials and sm is not None and hasattr(sm, "predict_cands"):
+            tk.pred = sm.predict_cands(tk.cands)
+
+    def _journal_step(self, tk: _Ticket, live: List[Trial],
+                      evaluated: int, withdrawn: bool,
+                      was_new_best: bool, nb_flags: List[bool],
+                      new: float, dropped: int, t_wait: float,
+                      snap_v: int, lag: int) -> None:
+        """One journal 'step' row per finalized ticket, carrying every
+        live trial's outcome as parallel arrays — the measured
+        (user-oriented) QoR joined with the surrogate's propose-time
+        predictive moments (the calibration stream `ut report` and the
+        online QualityMonitor consume).  One row per TICKET, not per
+        trial: serializing per trial measured ~15 us on this hot path,
+        enough to break the BENCH_OBS >= 0.95x bar on its own.  Every
+        value is a plain python scalar: the journal never holds a
+        device buffer."""
+        row: Dict[str, Any] = {
+            "ev": "step", "step": self.steps, "arm": tk.arm_name,
+            "evaluated": evaluated, "withdrawn": withdrawn,
+            "new_best": was_new_best,
+            "best": (round(self.sign * new, 6)
+                     if math.isfinite(new) else None),
+            "evals": self.evals, "pruned": int(tk.pruned),
+            "hist_dropped": int(dropped),
+            "t_wait": round(t_wait, 6), "snap_v": snap_v, "lag": lag}
+        if tk.jpull is not None:
+            (row["src"], row["batch"], row["trials"], _,
+             row["filtered"], row["dup"]) = tk.jpull
+        if self.sense == "max":
+            row["sense"] = "max"
+        if live:
+            # compact encoding (obs/journal.py EVENT_KINDS): arrays
+            # whose value is the documented default are omitted —
+            # `ok` absent = all true, `nb` absent = all false, `durs`
+            # absent = all zero, contiguous gids collapse to `gid0`.
+            # Most rows hit every default, halving both the
+            # serialization bytes and the allocation pressure (gen0
+            # GC passes in a jax-sized process are part of the
+            # BENCH_OBS budget)
+            sign = self.sign
+            g0 = live[0].gid
+            if all(tr.gid == g0 + i for i, tr in enumerate(live)):
+                row["gid0"] = g0
+            else:
+                row["gids"] = [tr.gid for tr in live]
+            # one pass, one list in the common all-finite case
+            qors: List[Any] = []
+            all_ok = True
+            for tr in live:
+                if math.isfinite(tr.qor):
+                    qors.append(round(sign * tr.qor, 6))
+                else:
+                    qors.append(None)
+                    all_ok = False
+            row["qors"] = qors
+            if not all_ok:
+                row["ok"] = [q is not None for q in qors]
+            if any(nb_flags):
+                row["nb"] = nb_flags
+            if any(tr.dur for tr in live):
+                row["durs"] = [round(tr.dur, 6) for tr in live]
+            if tk.pred is not None:
+                mu, sd, ver = tk.pred
+                row["mus"] = [round(float(sign * mu[tr.row]), 6)
+                              for tr in live]
+                row["sigmas"] = [round(float(sd[tr.row]), 6)
+                                 for tr in live]
+                # propose-time snapshot version of the prediction —
+                # distinct from the TELL-time `snap_v`/`lag` pair
+                # above, which samples the plane at finalize
+                row["pred_v"] = int(ver)
+        obs.journal.emit_row(row)
 
     def inject(self, cfgs: Sequence[Dict[str, Any]],
                source: str = "seed") -> List[Trial]:
@@ -1063,6 +1165,8 @@ class Tuner:
         was_new_best = new < prev
 
         running = prev
+        jn = obs.journal.enabled()
+        nb_flags: List[bool] = [] if jn else None
         for tr in live:
             is_best = tr.qor < running
             running = min(running, tr.qor)
@@ -1071,6 +1175,8 @@ class Tuner:
                             [p[tr.slot] for p in tk.perms_np],
                             self.sign * tr.qor, is_best, tr.dur)
             self.trace.append(self.sign * running)
+            if jn:
+                nb_flags.append(is_best)
         self.evals += evaluated
 
         if not tk.injected and not withdrawn:
@@ -1160,6 +1266,10 @@ class Tuner:
                           evaluated, self.sign * new, was_new_best,
                           tk.pruned, dropped, tk.t_propose, tk.t_dedup,
                           t_wait, t_refit, snap_v, lag)
+        if jn:
+            self._journal_step(tk, live, evaluated, withdrawn,
+                               was_new_best, nb_flags, new, dropped,
+                               t_wait, snap_v, lag)
         if obs.enabled():
             obs.event("ticket.finalize", arm=tk.arm_name,
                       evaluated=evaluated, withdrawn=withdrawn,

@@ -410,6 +410,14 @@ class ProgramTuner:
         t0 = time.perf_counter()
         qor = self._verdict(row.get("qor"), trial.config)
         stats = self.tuner.tell(trial, qor, float(row.get("dur", 0.0)))
+        if obs.journal.enabled():
+            # store-hit attribution for the search-quality stream: the
+            # tell row above records the outcome, this row records that
+            # it cost no build (docs/OBSERVABILITY.md, ISSUE 12)
+            obs.journal.emit(
+                "store_hit", gid=trial.gid,
+                qor=None if qor is None else round(float(qor), 6),
+                dur=round(float(row.get("dur", 0.0)), 6))
         if obs.enabled():
             # the bypass lane: a served ticket's gid shows up HERE and
             # never on a worker-N build lane
@@ -506,6 +514,9 @@ class ProgramTuner:
             self.exchange_injected += len(injected)
             obs.event("store.exchange", qor=float(row["qor"]))
             obs.count("store.exchange_injected", len(injected))
+            if obs.journal.enabled():
+                obs.journal.emit("exchange",
+                                 qor=round(float(row["qor"]), 6))
             # serve ahead of speculative technique work
             queue.extendleft(reversed(injected))
 

@@ -282,6 +282,8 @@ class WorkerPool:
             obs.count("pool.sidecar_events", n_child)
         obs.observe("pool.build_s", pdur)
         obs.gauge("pool.utilization", self.utilization())
+        if obs.journal.enabled():
+            self._journal_child_rows(slot, trial)
         if killed:
             obs.count("pool.timeouts")
         slot.proc = slot.trial = slot.log_f = slot.err_f = None
@@ -289,6 +291,39 @@ class WorkerPool:
         if killed:
             self._replace_sandbox(slot)
         return trial, qor, dur, info
+
+    @staticmethod
+    def _journal_child_rows(slot: _Slot, trial) -> None:
+        """Surface the trial's `ut.feature` covariates and `ut.interm`
+        feature vector into the tuning journal (ISSUE 12 satellite):
+        the child persisted them to its sandbox (api/report.py), the
+        reference fed exactly these rows to its QoR estimator, and the
+        journal is where a future transfer prior (ROADMAP item 4b)
+        reads them joined to a gid.  `ut.features.json` is cleared at
+        submit, so whatever is here came from THIS trial; covars.json
+        accumulates per sandbox by design — the current dict is the
+        trial's observed state.  Only reached when the journal is on;
+        unreadable/absent files are routine (most programs call
+        neither API)."""
+        from ..api.report import COVARS_FILE, FEATURES_FILE
+        gid = getattr(trial, "gid", None)
+        try:
+            with open(os.path.join(slot.sandbox, COVARS_FILE)) as f:
+                covars = json.load(f)
+            if isinstance(covars, dict) and covars:
+                obs.journal.emit("feature", gid=gid, covars=covars)
+        except (OSError, json.JSONDecodeError):
+            pass
+        try:
+            with open(os.path.join(slot.sandbox, FEATURES_FILE)) as f:
+                rows = json.load(f)
+            # [[index, feats]] (api/report.py interm): journal the
+            # vector of the last (only) row
+            if rows and isinstance(rows[-1], list) and len(rows[-1]) == 2:
+                obs.journal.emit("interm", gid=gid,
+                                 feats=list(rows[-1][1]))
+        except (OSError, json.JSONDecodeError):
+            pass
 
     def poll(self, timeout: float = 0.05
              ) -> List[Tuple[Any, Optional[float], float, Dict[str, Any]]]:

@@ -27,7 +27,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-from . import flight
+from . import flight, journal, quality
 from .core import (DEFAULT_CAPACITY, complete_span, device_span,
                    disable, emit_at, enable, enabled, event,
                    new_span_id, now, reset, snapshot, span,
@@ -47,7 +47,38 @@ __all__ = [
     "validate_trace", "now", "trace_origin_unix",
     "maybe_enable_from_env", "finish", "start_flight_recorder",
     "install_exit_flush", "instrument_device_fn", "DEFAULT_CAPACITY",
+    "journal", "quality", "start_journal", "stop_journal",
+    "maybe_journal_from_env",
 ]
+
+
+def start_journal(path: str, meta: Optional[Dict[str, Any]] = None,
+                  monitor: bool = True):
+    """Start the tuning journal (obs.journal) and, by default, attach
+    a publishing `QualityMonitor` so convergence/calibration gauges
+    ride the metrics registry, the flight recorder, and `ut top`'s
+    search panel for free (docs/OBSERVABILITY.md "Search-quality
+    telemetry").  Returns the monitor (or None)."""
+    journal.start(path, meta=meta)
+    return quality.attach() if monitor else None
+
+
+def stop_journal(mon=None) -> Optional[str]:
+    """Flush + close the journal; detaches `mon` when given."""
+    if mon is not None:
+        quality.detach(mon)
+    return journal.stop()
+
+
+def maybe_journal_from_env(env: Optional[dict] = None):
+    """`UT_JOURNAL=<path>` starts the tuning journal for this process
+    (the CLI's `--journal` flag layers above it).  Returns the
+    attached QualityMonitor, or None when unset/disabled."""
+    e = os.environ if env is None else env
+    val = e.get("UT_JOURNAL", "").strip()
+    if not val or journal.disabled_token(val):
+        return None
+    return start_journal(val)
 
 
 def instrument_device_fn(fn, name: str, **attrs):
@@ -150,6 +181,9 @@ def _flush_all(reason: str) -> None:
                 finish(path, extra={**extra, "flushed_on": reason})
             except OSError:
                 pass        # output dir vanished: nothing to save to
+        # the tuning journal's buffered tail rides the same graceful
+        # flush: an interrupted run keeps its search telemetry too
+        journal.flush()
     finally:
         _FLUSH_STATE["flushing"] = False
 
@@ -158,12 +192,16 @@ def _flush_atexit() -> None:
     _flush_all(_FLUSH_STATE["reason"] or "atexit")
 
 
-def install_exit_flush(path: str,
+def install_exit_flush(path: Optional[str],
                        extra: Optional[Dict[str, Any]] = None) -> None:
     """Register `path` for graceful telemetry flushing: the trace (and
     the flight recorder's final row) is written at interpreter exit,
     not only on the clean end-of-run `finish()` path — including exits
-    forced by SIGINT/SIGTERM.  The signal handlers themselves do NO
+    forced by SIGINT/SIGTERM.  `path=None` installs the hooks without
+    registering a trace — the journal-without-trace shape: a SIGTERM'd
+    `ut serve --journal` must still flush its buffered journal tail
+    (and unwind through the server's own finally), even though there
+    is no trace document to write.  The signal handlers themselves do NO
     I/O and take NO locks: a Python signal handler runs on the main
     thread between bytecodes, possibly inside a frame that already
     holds the (non-reentrant) metrics/ring locks the flush needs, so
@@ -179,7 +217,8 @@ def install_exit_flush(path: str,
     import signal
     import sys
 
-    _FLUSH_REGISTRY[path] = dict(extra or {})
+    if path is not None:
+        _FLUSH_REGISTRY[path] = dict(extra or {})
     if _FLUSH_STATE["hooked"]:
         return
     _FLUSH_STATE["hooked"] = True

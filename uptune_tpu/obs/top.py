@@ -18,9 +18,11 @@ operator needs before pod-scale work lands (ROADMAP items 1 and 3).
 Every field is pulled defensively: a metrics stream missing a family
 (a driver run has no `serve.*`) renders "—", never a crash.
 
-``--once`` prints a single frame and exits (scripts, tests); the
-refresh loop redraws with ANSI cursor-home + clear and exits cleanly
-on ^C / a vanished server.
+``--once`` prints a single frame and exits (scripts, tests); with
+``--json`` that frame is one JSON object (counters/gauges/hists/rates)
+so CI asserts on fields instead of scraping text.  The refresh loop
+redraws with ANSI cursor-home + clear and exits cleanly on ^C / a
+vanished server.
 """
 from __future__ import annotations
 
@@ -179,6 +181,24 @@ def render(prev: Optional[Sample], cur: Sample, source: str,
             _fmt(c.get("serve.new_bests", c.get("driver.new_bests")),
                  nd=0)),
     ]
+    # search-quality panel (ISSUE 12): the journal-derived gauges a
+    # QualityMonitor publishes; a run without a journal renders "—"
+    if any(k.startswith("search.") for k in g):
+        lines += [
+            "search    best {}   tells {}   since-best {}   "
+            "regret {}".format(
+                _fmt(g.get("search.best_qor"), nd=4),
+                _fmt(g.get("search.tells"), nd=0),
+                _fmt(g.get("search.tells_since_best"), nd=0),
+                _fmt(g.get("search.regret_proxy"), nd=4)),
+            "quality   cal MAE {}   rank-corr {}   cover95 {}   "
+            "dup {}   alerts {}".format(
+                _fmt(g.get("search.cal_mae"), nd=4),
+                _fmt(g.get("search.cal_rank_corr"), nd=2),
+                _fmt(g.get("search.cal_cover95"), nd=2),
+                _fmt(g.get("search.dup_rate"), nd=2),
+                _fmt(c.get("search.alerts", 0), nd=0)),
+        ]
     # anything moving that the fixed panel doesn't show (top deltas)
     shown = {"serve.asks", "serve.tells", "serve.proposes",
              "serve.store_served", "driver.asks", "driver.told",
@@ -210,7 +230,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="refresh cadence in seconds (default 2)")
     p.add_argument("--once", action="store_true",
                    help="print one frame and exit (scripts/tests)")
+    p.add_argument("--json", action="store_true",
+                   help="with --once: print the frame as one JSON "
+                        "object (counters, gauges, hists, computed "
+                        "rates, meta) instead of the rendered text, "
+                        "so scripts/CI assert on fields rather than "
+                        "scraping the dashboard")
     args = p.parse_args(argv)
+    if args.json and not args.once:
+        p.error("--json requires --once (one machine-readable frame)")
 
     client = None
     prev: Optional[Sample] = None
@@ -242,6 +270,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if args.once:
                     return 1
             else:
+                if args.once and args.json:
+                    print(json.dumps(
+                        {"t": cur.t, "source": source,
+                         "counters": cur.counters,
+                         "gauges": cur.gauges, "hists": cur.hists,
+                         "rates": rates(prev, cur),
+                         "window_s": cur.dt, "meta": cur.meta},
+                        sort_keys=True))
+                    return 0
                 frame = render(prev, cur, source)
                 if args.once:
                     print(frame)

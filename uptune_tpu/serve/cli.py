@@ -57,6 +57,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "server's metrics timeline (default 1.0; 0 "
                         "disables).  `ut top --metrics "
                         "OUT.json.metrics.jsonl` tails it live")
+    p.add_argument("--journal", default=None, metavar="OUT.jsonl",
+                   help="tuning journal (docs/OBSERVABILITY.md "
+                        "'Search-quality telemetry'): one JSONL row "
+                        "per session tell, plus the live "
+                        "convergence/calibration gauges derived from "
+                        "them; render post-hoc with `ut report`.  "
+                        "Also reachable via UT_JOURNAL; 'off' "
+                        "disables")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -114,11 +122,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         if mi > 0:
             obs.start_flight_recorder(trace_path, interval=mi)
 
+    # tuning journal (ISSUE 12): per-tenant serve_tell rows + the
+    # derived search-quality gauges (which the metrics op and `ut top`
+    # then expose).  Flag > UT_JOURNAL env; 'off' disables
+    journal_path = args.journal
+    if journal_path is None:
+        mon = obs.maybe_journal_from_env()
+        journal_path = obs.journal.path() if mon is not None else None
+    elif obs.journal.disabled_token(journal_path):
+        # same disable vocabulary as the tuning CLI / UT_JOURNAL
+        journal_path = None
+        mon = None
+    else:
+        mon = obs.start_journal(journal_path,
+                                meta={"process": "ut-serve"})
+    if journal_path and not trace_path:
+        # journal without trace: SIGINT/SIGTERM must still flush the
+        # buffered journal tail (and unwind into the finally below)
+        obs.install_exit_flush(None)
+
     from .server import SessionServer
     srv = SessionServer(**resolve_config(args))
     try:
         srv.serve_forever()
     finally:
+        if journal_path:
+            obs.stop_journal(mon)
+            log.info("[ut-serve] journal written to %s (render with "
+                     "`ut report %s`)", journal_path, journal_path)
         if trace_path:
             obs.finish(trace_path, extra={"process": "ut-serve"})
             log.info("[ut-serve] trace written to %s", trace_path)

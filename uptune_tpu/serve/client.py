@@ -133,6 +133,14 @@ class SessionClient:
     def stats(self) -> Dict[str, Any]:
         return self.request("stats")
 
+    def health(self, session: Optional[str] = None,
+               **thresholds: Any) -> Dict[str, Any]:
+        """Per-session search-quality verdicts (docs/SERVING.md): a
+        session id narrows to one tenant; without it the server
+        returns a bounded worst-first roll-up.  `stall_tells=` /
+        `fail_rate_hi=` override the server thresholds per call."""
+        return self.request("health", session=session, **thresholds)
+
     def open_session(self, space: Any, *, seed: int = 0,
                      program: str = "",
                      sense: str = "min",
@@ -205,6 +213,11 @@ class SessionHandle:
 
     def best(self) -> Dict[str, Any]:
         return self.client.request("best", session=self.id)
+
+    def health(self, **thresholds: Any) -> Dict[str, Any]:
+        """This session's quality verdict ({"op": "health"})."""
+        return self.client.request("health", session=self.id,
+                                   **thresholds)["health"]
 
     def close(self) -> None:
         try:

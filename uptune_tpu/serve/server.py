@@ -8,6 +8,7 @@ connection, every request one JSON object with an ``op`` field.
     {"op": "best", "session": s}
     {"op": "close", "session": s}
     {"op": "metrics"}                 -> the obs metrics scrape
+    {"op": "health", "session": s}    -> per-session search quality
     {"op": "stats"} / {"op": "ping"}
 
 ``SessionServer.handle(request) -> response`` is the transport-free
@@ -327,6 +328,48 @@ class SessionServer:
                 f"metrics format must be json|prometheus: {fmt!r}")
         return out
 
+    # health-op defaults: a serve tenant's epochs are narrow (batch
+    # rows, not driver tickets), so the stall bar sits far below the
+    # driver-side QualityConfig default; request fields override
+    HEALTH_STALL_TELLS = 64
+    HEALTH_FAIL_RATE_HI = 0.5
+    HEALTH_MAX_SESSIONS = 64
+
+    def _op_health(self, req: dict) -> dict:
+        """Per-session search-quality verdicts (ISSUE 12): with a
+        ``session`` field, that tenant's health; without, a bounded
+        roll-up over every live session — what a sharded front tier
+        (ROADMAP item 1) polls to decide placement/eviction.  Optional
+        ``stall_tells`` / ``fail_rate_hi`` override the thresholds for
+        this request only (docs/SERVING.md)."""
+        try:
+            stall = int(req.get("stall_tells", self.HEALTH_STALL_TELLS))
+            frh = float(req.get("fail_rate_hi",
+                                self.HEALTH_FAIL_RATE_HI))
+        except (TypeError, ValueError) as e:
+            raise RequestError(
+                f"stall_tells/fail_rate_hi must be numbers: {e}")
+        if req.get("session") is not None:
+            return {"health": self._session(req).health(
+                stall_tells=stall, fail_rate_hi=frh)}
+        with self._lock:
+            sessions = list(self._sessions.values())
+        rows = [s.health(stall_tells=stall, fail_rate_hi=frh)
+                for s in sessions]
+        by_status: Dict[str, int] = {}
+        for r in rows:
+            by_status[r["status"]] = by_status.get(r["status"], 0) + 1
+        # bounded payload: worst-first (failing, stalled, cold, ok) so
+        # a truncated roll-up still surfaces every unhealthy tenant
+        # ahead of the healthy tail
+        rank = {"failing": 0, "stalled": 1, "cold": 2, "ok": 3}
+        rows.sort(key=lambda r: (rank.get(r["status"], 4),
+                                 r["session"]))
+        truncated = len(rows) > self.HEALTH_MAX_SESSIONS
+        return {"sessions": len(rows), "by_status": by_status,
+                "truncated": truncated,
+                "health": rows[:self.HEALTH_MAX_SESSIONS]}
+
     def _op_stats(self, req: dict) -> dict:
         with self._lock:
             groups = [{"space": g.key[0][0][:60] if g.key[0] else "",
@@ -343,7 +386,8 @@ class SessionServer:
 
     _OPS = {"ping": _op_ping, "open": _op_open, "ask": _op_ask,
             "tell": _op_tell, "best": _op_best, "close": _op_close,
-            "metrics": _op_metrics, "stats": _op_stats}
+            "metrics": _op_metrics, "stats": _op_stats,
+            "health": _op_health}
 
     def handle(self, req: Any) -> dict:
         """Transport-free dispatch: one request dict -> one response

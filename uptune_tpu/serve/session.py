@@ -28,6 +28,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 import numpy as np
 
 from .. import obs
+from ..obs.quality import SessionQuality
 from ..store.keys import canon_config
 
 
@@ -113,6 +114,10 @@ class Session:
         self.store_served = 0       # rows auto-filled from the memo
         self.closed = False
         self._ticket_seq = 0
+        # per-tenant search-quality accumulator (ISSUE 12): a few ints
+        # + one bounded ring, updated at tell time under the group
+        # lock, read by the server's {"op": "health"} op — always on
+        self.quality = SessionQuality()
 
     # -- internals -----------------------------------------------------
     def _offer_best(self, cfg: dict, qor: float) -> bool:
@@ -255,11 +260,22 @@ class Session:
             if finite:
                 new_best = self._offer_best(cfg, v)
             self.tells += 1
+            self.quality.on_tell(finite, new_best)
             committed = False
             if p.settled():
                 self._commit()
                 committed = True
             version = self.version
+        if obs.journal.enabled():
+            # the server-side tuning journal (per-tenant stream): one
+            # row per committed tell, so `ut report` over a server's
+            # journal shows each session's progress and the health op's
+            # verdicts are reconstructible offline (ISSUE 12)
+            obs.journal.emit(
+                "serve_tell", session=self.id, ok=finite,
+                qor=round(v, 6) if finite else None,
+                new_best=new_best, committed=committed,
+                version=version)
         # the memo write happens OUTSIDE the group lock (the store has
         # its own lock; a racing reader either hits or re-measures —
         # never a correctness matter), keeping disk appends off the
@@ -284,6 +300,19 @@ class Session:
                     "version": self.version, "asks": self.asks,
                     "tells": self.tells,
                     "store_served": self.store_served}
+
+    def health(self, *, stall_tells: int = 64,
+               fail_rate_hi: float = 0.5) -> Dict[str, Any]:
+        """Per-session quality verdict (never a device sync): the
+        SessionQuality status plus the counters a poller needs to act
+        on it — the serve `{"op": "health"}` payload."""
+        with self.group.lock:
+            out = {"session": self.id, "version": self.version,
+                   "asks": self.asks, "store_served": self.store_served,
+                   "best_qor": self.best_qor}
+            out.update(self.quality.health(stall_tells=stall_tells,
+                                           fail_rate_hi=fail_rate_hi))
+            return out
 
     def close(self) -> None:
         with self.group.lock:
@@ -335,6 +364,9 @@ class LocalSession:
 
     def best(self) -> Dict[str, Any]:
         return self._session.best()
+
+    def health(self, **kw) -> Dict[str, Any]:
+        return self._session.health(**kw)
 
     @property
     def version(self) -> int:

@@ -338,6 +338,14 @@ class SurrogateManager:
                 bb: jax.jit(lambda st, xq, b: gp_mod.expected_improvement(
                     st, xq, b, n_cont=nc, n_cat=ncat))
                 for bb in self._buckets}
+            # predictive moments for the tuning journal's calibration
+            # join (ISSUE 12): one wrapper per bucket, built up-front
+            # like every other fleet so strict trace accounting stays
+            # clean — each traces once, on its first journaled ticket
+            self._pred_jit = {
+                bb: jax.jit(lambda st, xq: gp_mod.predict(
+                    st, xq, n_cont=nc, n_cat=ncat))
+                for bb in self._buckets}
             if self.incremental:
                 self._ext_jit = {
                     bb: jax.jit(lambda st, xr, yr, sl: gp_mod.extend(
@@ -352,6 +360,15 @@ class SurrogateManager:
                     k, x, y, n_members=n_members, mask=mask))
                 for bb in self._buckets}
             self._score = jax.jit(mlp_mod.predict_members)
+
+            def _mlp_moments(st, xq):
+                preds = mlp_mod.predict_members(st, xq)
+                return preds.mean(axis=0), preds.std(axis=0)
+
+            # ensemble params are bucket-independent: one moments
+            # wrapper serves every bucket (same rule as _score)
+            one_pred = jax.jit(_mlp_moments)
+            self._pred_jit = {bb: one_pred for bb in self._buckets}
 
     # ------------------------------------------------------------------
     def _sx(self, feats):
@@ -592,6 +609,9 @@ class SurrogateManager:
         obs.event("surrogate.publish", version=self._version,
                   n_rows=n_total, bucket=bucket)
         obs.gauge("surrogate.refits_published", self.refits)
+        if obs.journal.enabled():
+            obs.journal.emit("snapshot", version=self._version,
+                             n_rows=int(n_total), bucket=int(bucket))
         ext = self._ext_jit.get(bucket)
         if ext is not None and n < bucket and n_total <= self.max_points:
             # warm the extension wrapper for THIS bucket on the refit
@@ -755,6 +775,23 @@ class SurrogateManager:
             return jnp.asarray(u, jnp.float32)
         w = np.asarray(w, np.float64) / float(np.sum(w))
         return jnp.asarray(0.75 * w + 0.25 * u, jnp.float32)
+
+    def predict_cands(self, cands: CandBatch):
+        """Predictive moments for a candidate batch against the
+        CURRENT published snapshot: ``(mu [B], sd [B], version)`` as
+        host numpy arrays (engine-oriented targets), or None when not
+        fitted.  The tuning journal's calibration join (ISSUE 12): the
+        driver records these at propose time and joins them with the
+        measured QoR at tell — call sites gate on the journal flag, so
+        an unjournaled run never pays the extra dispatch."""
+        snap = self._snap   # one atomic snapshot read (see keep_mask)
+        if snap is None:
+            return None
+        feats = self._sx(self.space.features(cands))
+        bucket = (int(snap.state.x.shape[0]) if self.kind == "gp"
+                  else self._buckets[0])
+        mu, sd = self._pred_jit[bucket](snap.state, feats)
+        return np.asarray(mu), np.asarray(sd), snap.version
 
     # ------------------------------------------------------------------
     def keep_mask(self, cands: CandBatch,
