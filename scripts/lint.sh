@@ -33,9 +33,11 @@ fi
 # plane whose instrumentation lives INSIDE every hot path (ISSUE 7 —
 # a silenced hazard there would tax or skew the very measurements it
 # exists to make; the ISSUE 10 distributed-obs modules — sidecar,
-# flight, merge, top — and the ISSUE 12 search-quality modules —
-# journal, quality, report — are part of the obs/ package and inherit
-# the rule), and the multi-tenant serving plane (ISSUE 8 — a silenced
+# flight, merge, top — the ISSUE 12 search-quality modules —
+# journal, quality, report — and the ISSUE 13 device-telemetry
+# module — device.py, which wraps EVERY engine/driver device program
+# — are part of the obs/ package and inherit the rule), and the
+# multi-tenant serving plane (ISSUE 8 — a silenced
 # retrace or host-sync hazard there stalls EVERY tenant at once) get
 # no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'

@@ -419,6 +419,17 @@ class TestBenchMultiSmoke:
         # strict guard: every wrapper in the measured region compiled
         # exactly once
         assert res["retraces"]["excess"] == {}, res["retraces"]
+        # the roofline fields are sourced from the shared obs.device
+        # module since ISSUE 13 (bench.py owns no private peak table):
+        # measured cost-model + memory-plan fields must be present
+        ca = res["cost_analysis"]
+        assert ca["total_flops"] and ca["total_bytes_accessed"]
+        assert ca["flops_per_s"] and ca["bytes_per_s"]
+        assert ca["arith_intensity"] is not None
+        assert ca["peak_memory"]["temp_bytes"] >= 0
+        assert ca["peak_memory"]["argument_bytes"] > 0
+        assert "obs.device" in ca["source"] or \
+            "obs/device" in ca["note"]
         path = os.path.join(REPO, "BENCH_MULTI.quick.json")
         assert os.path.exists(path)
         with open(path) as f:

@@ -1,0 +1,395 @@
+"""Device-plane telemetry (uptune_tpu/obs/device.py, ISSUE 13):
+cost/memory harvest on the CPU backend, peak-table resolution by
+device_kind substring with unknown-device fallback, the instrument
+seam's AOT harvest + disabled-path no-op contract, persistent
+compile-cache hit/miss attribution, driver StepStats compile fields,
+the `ut top` device panel, and the `ut report` "Device & compile"
+section."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import uptune_tpu
+from uptune_tpu import obs
+from uptune_tpu.obs import device
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+
+
+@pytest.fixture(autouse=True)
+def obs_clean():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# --------------------------------------------------------- peak table
+class TestPeakTable:
+    def test_resolution_by_substring(self):
+        assert device.resolve_peaks("TPU v4") == (275e12, 1200e9)
+        assert device.resolve_peaks("TPU v5 lite") == (197e12, 819e9)
+        # case-insensitive, anywhere in the kind string
+        assert device.resolve_peaks("Cloud TPU V5P pod") == \
+            (459e12, 2765e9)
+
+    def test_unknown_device_fallback(self):
+        """A device the table doesn't know gets NO roofline claims —
+        not a made-up estimate (the CPU-fallback honesty rule)."""
+        assert device.resolve_peaks("Banana 9000") is None
+        assert device.resolve_peaks("") is None
+        assert device.resolve_peaks(None) is None
+        assert device.utilization("cpu", 1e9, 1e9) == {}
+
+    def test_utilization_fields(self):
+        u = device.utilization("TPU v4", 275e11, 120e9)
+        assert u["peak_flops_per_s"] == 275e12
+        assert u["peak_hbm_bytes_per_s"] == 1200e9
+        assert u["mxu_util"] == pytest.approx(0.1)
+        assert u["hbm_util"] == pytest.approx(0.1)
+        # peaks present, rates absent: utilization keys omitted
+        u2 = device.utilization("TPU v4")
+        assert "mxu_util" not in u2 and "peak_flops_per_s" in u2
+
+
+# ------------------------------------------------------------ harvest
+class TestHarvest:
+    def test_cpu_backend_fields_present(self):
+        """XLA exposes cost_analysis AND memory_analysis on the CPU
+        backend: the full schema must come back populated."""
+        fn = jax.jit(lambda x: jnp.sin(x) @ x.T)
+        rec = device.harvest(fn.lower(jnp.ones((16, 16))).compile())
+        device.validate_record(rec)
+        assert rec["flops"] > 0
+        assert rec["bytes_accessed"] > 0
+        assert rec["arith_intensity"] == pytest.approx(
+            rec["flops"] / rec["bytes_accessed"], rel=1e-3)
+        pm = rec["peak_memory"]
+        assert pm["argument_bytes"] == 16 * 16 * 4
+        assert pm["output_bytes"] == 16 * 16 * 4
+
+    def test_schema_rejects_malformed(self):
+        ok = {"flops": 1.0, "bytes_accessed": 2.0,
+              "transcendentals": None, "arith_intensity": 0.5,
+              "peak_memory": None}
+        device.validate_record(ok)
+        with pytest.raises(ValueError):
+            device.validate_record({**ok, "flops": -1.0})
+        with pytest.raises(ValueError):
+            device.validate_record(
+                {k: v for k, v in ok.items() if k != "bytes_accessed"})
+        with pytest.raises(ValueError):
+            device.validate_record(
+                {**ok, "peak_memory": {"temp_bytes": "big"}})
+        with pytest.raises(ValueError):
+            device.validate_record([ok])
+
+    def test_harvest_tolerates_opaque_object(self):
+        """A backend without the analyses yields the all-None schema,
+        never a raise."""
+        rec = device.harvest(object())
+        device.validate_record(rec)
+        assert rec["flops"] is None and rec["peak_memory"] is None
+
+
+# --------------------------------------------------------- instrument
+class TestInstrument:
+    def test_disabled_path_is_noop(self):
+        """With tracing off the wrapper calls through: no spans, no
+        metrics, no registry entry — and the span layer underneath is
+        the shared no-op singleton."""
+        assert not obs.enabled()
+        f = obs.instrument_device_fn(
+            jax.jit(lambda x: x.sum()), "dev.off")
+        assert float(f(jnp.ones((8,)))) == 8.0
+        assert obs.span("x") is obs.device_span("y")   # shared NOOP
+        assert device.programs() == {}
+        assert obs.metrics_snapshot()["counters"] == {}
+        assert obs.snapshot()["events"] == []
+
+    def test_enabled_harvests_at_compile_time(self):
+        """First traced call: ONE engine.compile span, the cost model
+        harvested into the registry, device.* gauges published; later
+        calls reuse the AOT executable (no second compile)."""
+        obs.enable()
+        f = obs.instrument_device_fn(
+            jax.jit(lambda x: jnp.cos(x).sum()), "dev.fresh")
+        x = jnp.ones((32,))
+        r1, r2 = float(f(x)), float(f(x))
+        assert r1 == r2
+        rec = device.programs()["dev.fresh"]
+        device.validate_record(rec["cost"])
+        assert rec["compiles"] == 1 and rec["dispatches"] == 2
+        m = obs.metrics_snapshot()
+        assert m["counters"]["device.compiles"] == 1
+        assert m["counters"]["device.dispatches"] == 2
+        assert m["gauges"]["device.flops.dev.fresh"] > 0
+        assert m["gauges"]["device.programs"] == 1
+        spans = [e for e in obs.snapshot()["events"]
+                 if e["name"] == "engine.compile"]
+        assert len(spans) == 1
+        assert spans[0]["attrs"]["program"] == "dev.fresh"
+        assert spans[0]["attrs"]["cache"] in ("hit", "miss", "off")
+        assert device.compile_totals()[0] == 1
+        assert device.compile_totals()[1] > 0
+
+    def test_warm_program_is_not_relowered(self):
+        """A program first called while tracing was OFF must never be
+        lowered again on enable (a second trace would break the strict
+        trace-guard contract): dispatch telemetry only."""
+        f = obs.instrument_device_fn(
+            jax.jit(lambda x: x * 3.0), "dev.warm")
+        x = jnp.ones((4,))
+        f(x)                        # warm, untraced
+        obs.enable()
+        f(x)
+        rec = device.programs()["dev.warm"]
+        assert rec["cost"] is None and rec["compiles"] == 0
+        assert rec["dispatches"] == 1
+        assert not any(e["name"] == "engine.compile"
+                       for e in obs.snapshot()["events"])
+
+    def test_donation_preserved_through_aot_path(self):
+        obs.enable()
+        f = obs.instrument_device_fn(
+            jax.jit(lambda s: s + 1.0, donate_argnums=(0,)),
+            "dev.donate")
+        x = jnp.ones((8,))
+        y = f(x)
+        assert float(y[0]) == 2.0
+        assert x.is_deleted(), "donated input must be consumed"
+
+    def test_aval_drift_falls_back_to_jit(self):
+        """The engine plane's avals are fixed by design, but a caller
+        that does vary shapes must get correct results: the AOT
+        executable's TypeError routes back to the jit wrapper."""
+        obs.enable()
+        f = obs.instrument_device_fn(
+            jax.jit(lambda x: x * 2.0), "dev.drift")
+        assert float(f(jnp.ones((4,))).sum()) == 8.0
+        assert float(f(jnp.ones((6,))).sum()) == 12.0
+        assert float(f(jnp.ones((6,))).sum()) == 12.0
+        assert device.programs()["dev.drift"]["dispatches"] == 3
+
+    def test_lower_is_forwarded(self):
+        f = obs.instrument_device_fn(
+            jax.jit(lambda x: x - 1.0), "dev.lower")
+        compiled = f.lower(jnp.ones((4,))).compile()
+        rec = device.harvest(compiled)
+        device.validate_record(rec)
+
+    def test_record_window_publishes_roofline_gauges(self):
+        obs.enable()
+        f = obs.instrument_device_fn(
+            jax.jit(lambda x: jnp.sin(x) @ x.T), "dev.win")
+        jax.block_until_ready(f(jnp.ones((32, 32))))
+        out = device.record_window("dev.win", 1e-3,
+                                   device_kind="TPU v4")
+        assert out["achieved_flops_per_s"] > 0
+        assert out["peak_flops_per_s"] == 275e12
+        assert "mxu_util" in out and "hbm_util" in out
+        g = obs.metrics_snapshot()["gauges"]
+        assert g["device.achieved_flops_per_s.dev.win"] == \
+            out["achieved_flops_per_s"]
+        # aggregate (last-window) copies ride alongside for `ut top`
+        assert g["device.achieved_flops_per_s"] == \
+            out["achieved_flops_per_s"]
+        # unknown program / untraced: inert
+        assert device.record_window("nope", 1.0) == {}
+        obs.reset()
+        assert device.record_window("dev.win", 1.0) == {}
+
+
+class TestCompileCacheAttribution:
+    @pytest.fixture
+    def cache_dir(self, tmp_path):
+        cfg = jax.config
+        old = (cfg.jax_compilation_cache_dir,
+               cfg.jax_persistent_cache_min_compile_time_secs,
+               cfg.jax_persistent_cache_min_entry_size_bytes)
+        cfg.update("jax_compilation_cache_dir", str(tmp_path))
+        cfg.update("jax_persistent_cache_min_compile_time_secs", 0)
+        cfg.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        yield tmp_path
+        cfg.update("jax_compilation_cache_dir", old[0])
+        cfg.update("jax_persistent_cache_min_compile_time_secs", old[1])
+        cfg.update("jax_persistent_cache_min_entry_size_bytes", old[2])
+
+    def test_miss_then_hit(self, cache_dir):
+        """Two instrumented wrappers over the SAME computation: the
+        first compile MISSES the (fresh) persistent cache and writes
+        it, the second is served from disk — attributed per program
+        and in the device.* counters."""
+        obs.enable()
+        x = jnp.ones((64,))
+        fa = obs.instrument_device_fn(
+            jax.jit(lambda x: jnp.tanh(x) * 1.5), "dev.cache.a")
+        fa(x)
+        fb = obs.instrument_device_fn(
+            jax.jit(lambda x: jnp.tanh(x) * 1.5), "dev.cache.b")
+        fb(x)
+        progs = device.programs()
+        assert progs["dev.cache.a"]["cache"] == "miss", progs
+        assert progs["dev.cache.b"]["cache"] == "hit", progs
+        c = obs.metrics_snapshot()["counters"]
+        assert c["device.compile_cache_misses"] >= 1
+        assert c["device.compile_cache_hits"] >= 1
+        spans = {e["attrs"]["program"]: e["attrs"]["cache"]
+                 for e in obs.snapshot()["events"]
+                 if e["name"] == "engine.compile"}
+        assert spans == {"dev.cache.a": "miss", "dev.cache.b": "hit"}
+
+
+# --------------------------------------------------- driver StepStats
+class TestDriverStepStats:
+    def test_first_ticket_carries_compiles(self):
+        """With tracing on from construction, the first ticket's
+        window reports the arm programs' compiles (n_compiles > 0,
+        t_compile > 0); steady-state tickets report ~0.  Untraced
+        runs keep zeros."""
+        from uptune_tpu.driver import Tuner
+        from uptune_tpu.workloads import (rosenbrock_objective,
+                                          rosenbrock_space)
+        obs.enable()
+        t = Tuner(rosenbrock_space(2, -2.0, 2.0),
+                  rosenbrock_objective(2), seed=0,
+                  technique="DifferentialEvolution")
+        first = t.step()
+        later = t.step()
+        res = t.result()
+        t.close()
+        assert first.n_compiles >= 3          # propose+commit+observe
+        assert first.t_compile > 0
+        assert later.n_compiles == 0 and later.t_compile == 0.0
+        assert res.t_compile == pytest.approx(
+            first.t_compile + later.t_compile)
+        progs = device.programs()
+        assert "driver.commit" in progs
+        assert any(k.startswith("driver.propose.") for k in progs)
+
+
+# ------------------------------------------------------ profiler dump
+class TestDeviceTrace:
+    def test_capture_and_export_reference(self, tmp_path):
+        """start_trace/stop_trace wrap jax.profiler: the XPlane dump
+        lands under the dir, and a Chrome-trace export written while
+        the capture ran references it (otherData.device_trace) — the
+        combined-Perfetto-view contract."""
+        obs.enable()
+        d = str(tmp_path / "devtrace")
+        assert device.start_trace(d) == d
+        assert device.start_trace(d) == d      # idempotent while active
+        jax.block_until_ready(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+        assert device.stop_trace() == d
+        dumps = [f for root, _, files in os.walk(d) for f in files
+                 if f.endswith(".xplane.pb")]
+        assert dumps, "profiler dump missing"
+        doc = obs.chrome_trace()
+        assert doc["otherData"]["device_trace"] == d
+        obs.validate_trace(doc)
+
+    def test_env_gate(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("UT_DEVICE_TRACE", "off")
+        assert device.maybe_trace_from_env() is None
+        monkeypatch.delenv("UT_DEVICE_TRACE")
+        assert device.maybe_trace_from_env() is None
+
+
+# ----------------------------------------------------- top and report
+class TestTopDevicePanel:
+    def test_panel_renders_values_and_dashes(self):
+        from uptune_tpu.obs import top
+        s_empty = top.Sample(0.0, {}, {}, {})
+        frame = top.render(None, s_empty, "x")
+        dev_line = next(ln for ln in frame.splitlines()
+                        if ln.startswith("device"))
+        roof_line = next(ln for ln in frame.splitlines()
+                         if ln.startswith("roofline"))
+        assert "—" in dev_line and "—" in roof_line
+        s = top.Sample(
+            10.0,
+            {"device.compiles": 4, "device.compile_cache_hits": 3,
+             "device.compile_cache_misses": 1,
+             "device.dispatches": 500},
+            {"device.programs": 4,
+             "device.achieved_flops_per_s": 2.2e12,
+             "device.mxu_util": 0.008, "device.hbm_util": 0.41,
+             "device.arith_intensity": 0.37},
+            {"device.compile_ms": {"count": 4, "sum": 1234.5}},
+            deltas={"device.dispatches": 100}, dt=2.0)
+        frame = top.render(None, s, "x")
+        assert "compiles 4 (1,234 ms)" in frame
+        assert "cache hit/miss 3/1" in frame
+        assert "dispatches/s 50.0" in frame
+        assert "MXU 0.008000" in frame and "HBM 0.4100" in frame
+
+    def test_json_frame_carries_device_family(self):
+        """`ut top --json` frames are the raw counters/gauges — the
+        device.* family rides through untouched."""
+        from uptune_tpu.obs import top
+        row = {"t": 1.0, "dt": 1.0,
+               "counters": {"device.dispatches": 7},
+               "deltas": {"device.dispatches": 7},
+               "gauges": {"device.programs": 2}, "hists": {}}
+        s = top.sample_from_row(row)
+        assert s.counters["device.dispatches"] == 7
+        assert s.gauges["device.programs"] == 2
+        assert top.rates(None, s)["device.dispatches"] == 7.0
+
+
+class TestReportDeviceSection:
+    def _metrics_file(self, tmp_path, gauges, counters):
+        p = tmp_path / "m.metrics.jsonl"
+        row = {"t": 1.0, "dt": 1.0, "counters": counters,
+               "deltas": dict(counters), "gauges": gauges,
+               "hists": {"device.compile_ms":
+                         {"count": 2, "sum": 321.0}}}
+        p.write_text(json.dumps(row) + "\n")
+        return str(p)
+
+    def test_section_present_with_device_telemetry(self, tmp_path):
+        from uptune_tpu.obs import report
+        met = report.summarize_metrics(self._metrics_file(
+            tmp_path,
+            {"device.flops.engine.run": 1e9,
+             "device.bytes.engine.run": 4e9,
+             "device.arith_intensity.engine.run": 0.25,
+             "device.compile_ms.engine.run": 321.0,
+             "device.achieved_flops_per_s": 5e8,
+             "device.mxu_util": 0.002},
+            {"device.compiles": 2, "device.compile_cache_hits": 1,
+             "device.compile_cache_misses": 1,
+             "device.dispatches": 10}))
+        dev = report.device_summary(met)
+        assert dev["programs"]["engine.run"]["flops"] == 1e9
+        assert dev["compile"]["compiles"] == 2
+        assert dev["compile"]["compile_ms_total"] == 321.0
+        assert dev["roofline"]["mxu_util"] == 0.002
+        # both renderers carry the section (journal can be minimal)
+        jp = tmp_path / "j.jsonl"
+        jp.write_text(json.dumps(
+            {"v": 1, "origin_unix": 0.0, "meta": {}}) + "\n")
+        header, rows = obs.journal.read(str(jp))
+        an = report.analyze(header, rows)
+        md = report.render_markdown(an, met)
+        assert "## Device & compile" in md
+        assert "engine.run" in md
+        html = report.render_html(an, met)
+        assert "Device &amp; compile" in html
+        assert "compile-cache hits" in html
+
+    def test_section_absent_without_device_telemetry(self, tmp_path):
+        from uptune_tpu.obs import report
+        met = report.summarize_metrics(self._metrics_file(
+            tmp_path, {"serve.batch_fill": 1.0}, {"serve.asks": 5}))
+        assert report.device_summary(met) is None
+        assert report.device_summary(None) is None
+        jp = tmp_path / "j.jsonl"
+        jp.write_text(json.dumps(
+            {"v": 1, "origin_unix": 0.0, "meta": {}}) + "\n")
+        header, rows = obs.journal.read(str(jp))
+        md = report.render_markdown(report.analyze(header, rows), met)
+        assert "Device & compile" not in md

@@ -633,8 +633,10 @@ def test_package_suppression_free(package):
     invalidate every BENCH_* headline measured through them; obs/ is
     instrumentation living INSIDE every hot path (ISSUE 7; the
     ISSUE 10 distributed-obs modules — sidecar, flight recorder,
-    merge, top — and the ISSUE 12 search-quality modules — journal,
-    quality, report — live in the same package and inherit the rule)
+    merge, top — the ISSUE 12 search-quality modules — journal,
+    quality, report — and the ISSUE 13 device-telemetry module —
+    device.py, wrapping every engine/driver device program — live in
+    the same package and inherit the rule)
     — a silenced hazard there would tax or skew the measurements it
     exists to make; serve/ multiplexes every tenant onto three shared
     compiled programs (ISSUE 8) — a silenced retrace or host-sync

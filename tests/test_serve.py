@@ -382,6 +382,25 @@ class TestDistributedObs:
         # ops are tagged on both sides of the join
         assert {p["op"] for p in pairs} >= {"open", "ask", "tell"}
 
+    def test_scrape_carries_device_family(self, server):
+        """Device telemetry rides the metrics registry like every
+        other family (ISSUE 13): a traced server's engine-program
+        dispatches land in the `{"op": "metrics"}` scrape as
+        device.* counters with zero serve-plane plumbing."""
+        from uptune_tpu import obs
+        if not obs.enabled():
+            obs.enable()
+        with connect(("127.0.0.1", server.port)) as c:
+            with c.open_session(_space(), seed=34, store=False) as h:
+                for t in h.ask(2):
+                    h.tell(t.ticket, _measure(t.config))
+            m = c.metrics()
+        counters = m["metrics"]["counters"]
+        # join (init_slot) + ask (propose_all) + tell (commit_slot)
+        # all dispatch instrumented engine programs
+        assert counters.get("device.dispatches", 0) > 0
+        assert "device.dispatch_ms" in m["metrics"]["hists"]
+
     def test_untraced_client_sends_no_ctx(self, server):
         """The wire stays minimal for untraced clients: no ctx field
         leaves the process (asserted at the payload level)."""

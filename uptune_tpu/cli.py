@@ -208,6 +208,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the background thread and restores the "
                         "single end-of-run metrics snapshot).  Only "
                         "meaningful with --trace/UT_TRACE")
+    p.add_argument("--device-trace", default=None, metavar="DIR",
+                   help="programmatic jax.profiler capture for the "
+                        "whole run (docs/OBSERVABILITY.md 'Device "
+                        "telemetry'): the XPlane dump lands under "
+                        "DIR/plugins/profile/ and, when --trace is "
+                        "also on, is referenced from the Chrome-trace "
+                        "export (otherData.device_trace) so host "
+                        "spans and XLA kernels open side by side in "
+                        "Perfetto.  Also reachable via "
+                        "UT_DEVICE_TRACE=<dir>; 'off' disables")
     p.add_argument("--device", choices=("cpu", "accel"), default="cpu",
                    help="platform for the search engine (default cpu: "
                         "black-box evals dominate; 'accel' trusts the "
@@ -565,6 +575,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         if mi > 0:
             obs.start_flight_recorder(trace_path, interval=mi)
 
+    # device-plane profiler capture (ISSUE 13): flag > UT_DEVICE_TRACE
+    # env; independent of --trace (the XPlane dump stands alone in
+    # Perfetto), but a traced run's export references the dump dir
+    dtrace = args.device_trace
+    if dtrace is None:
+        dtrace = obs.device.maybe_trace_from_env()
+    elif dtrace.lower() in ("off", "none"):
+        dtrace = None
+    else:
+        dtrace = obs.device.start_trace(dtrace)
+
     # tuning journal (docs/OBSERVABILITY.md "Search-quality
     # telemetry"): flag > UT_JOURNAL env > ut.config('journal').
     # Resolved BEFORE starting so --num-hosts replicas suffix their
@@ -597,8 +618,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     # UT_TRACE_GUARD=1|strict: count per-function jit traces over the
     # whole tune (docs/LINT.md) — the proposal plane must compile once
     # per technique, not once per step
-    with guard_from_env() as guard:
-        res = run_auto(pt)   # single / multi-stage / decouple dispatch
+    try:
+        with guard_from_env() as guard:
+            res = run_auto(pt)   # single / multi-stage / decouple
+    finally:
+        if dtrace:
+            # settle the XPlane dump BEFORE the trace export so the
+            # referenced profile is complete when the document is
+            # written — including on a raising run (the obs exit
+            # flush also stops a still-active capture on SIGINT/
+            # SIGTERM paths that bypass this finally)
+            obs.device.stop_trace()
+            log.info("[ut] device profile captured under %s (open "
+                     "the xplane.pb in Perfetto next to the --trace "
+                     "export)", dtrace)
     if journal_path:
         # settle the journal BEFORE the trace export: detaching
         # finalizes the quality gauges into the metrics registry, so

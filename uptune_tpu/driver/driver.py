@@ -98,6 +98,14 @@ class StepStats(NamedTuple):
     t_refit: float = 0.0
     snapshot_version: int = 0
     refit_lag_rows: int = 0
+    # device-plane compile activity (obs.device totals delta) that
+    # landed in this ticket's open->finalize window: the first ticket
+    # carries the arm-program compiles, later tickets ~0, and a
+    # persistent-cache-served restart shows small t_compile with the
+    # cache hits attributed in the device.* counters.  Both stay 0
+    # while tracing is off (device telemetry rides the obs flag)
+    n_compiles: int = 0
+    t_compile: float = 0.0
 
 
 class Trial:
@@ -132,7 +140,7 @@ class _Ticket:
                  "src", "novel_np", "injected", "pruned", "trials",
                  "remaining", "u_np", "perms_np", "gen", "credit_virtual",
                  "packed", "t_propose", "t_dedup", "t_open", "pred",
-                 "jpull")
+                 "jpull", "dev0")
 
     def __init__(self, arm, arm_name, tstate, cands, hashes, known, src,
                  novel_np, injected, pruned, gen=0, credit_virtual=False):
@@ -166,6 +174,12 @@ class _Ticket:
         self.t_propose = 0.0      # s in the propose+dedup device call
         self.t_dedup = 0.0        # s in host-side mask + materialization
         self.t_open = 0.0         # perf_counter() when the ticket opened
+        # obs.device compile totals at open: finalize reports the
+        # window's (count, seconds) delta in StepStats (ISSUE 13).
+        # _acquire / _surrogate_ticket / _open_injected_ticket override
+        # with their pre-dispatch capture so a program's own first-pull
+        # compile lands in its ticket's window
+        self.dev0 = obs.device.compile_totals()
         # member-state generation at open time: a restart bumps the
         # member's generation, and stale tickets (opened before the
         # restart) must not write observe(tk.tstate) back over the
@@ -189,6 +203,9 @@ class TuneResult(NamedTuple):
     # cumulative seconds the driver hot path spent BLOCKED on surrogate
     # learning (sync refits; ~0 with the async surrogate plane)
     t_refit: float = 0.0
+    # cumulative XLA compile seconds observed by the device-telemetry
+    # layer across the run's ticket windows (obs.device; 0 untraced)
+    t_compile: float = 0.0
 
 
 class Tuner:
@@ -354,9 +371,15 @@ class Tuner:
         for t in self.members:
             self.key, k = jax.random.split(self.key)
             self._tstates[t.name] = _strong(t.init_state(space, k))
-            self._propose_jit[t.name] = jax.jit(
-                lambda st, k, best, hs, _t=t:
-                _propose_dedup(_t, st, k, best, hs))
+            # the driver's per-arm device programs ride the same
+            # instrument seam as the engine plane (ISSUE 13): a traced
+            # run harvests each program's XLA cost/memory analysis at
+            # its first-pull compile and attributes persistent-cache
+            # hits/misses; untraced runs pay one flag check
+            self._propose_jit[t.name] = obs.instrument_device_fn(
+                jax.jit(lambda st, k, best, hs, _t=t:
+                        _propose_dedup(_t, st, k, best, hs)),
+                f"driver.propose.{t.name}")
             # observe consumes the ticket's padded batch, slicing back
             # to the arm's own proposal rows; the technique state is
             # DONATED — tk.tstate must never be reused after this call.
@@ -407,14 +430,16 @@ class Tuner:
             best = best.update(cands, qor)
             return hist_state, best
 
-        self._dedup = _dedup
-        self._commit = jax.jit(_commit, donate_argnums=(0, 1))
+        self._dedup = obs.instrument_device_fn(_dedup, "driver.dedup")
+        self._commit = obs.instrument_device_fn(
+            jax.jit(_commit, donate_argnums=(0, 1)), "driver.commit")
         # driver-plane timing accumulators (seconds; surfaced via
         # StepStats per ticket and TuneResult totals)
         self.t_propose_total = 0.0
         self.t_dedup_total = 0.0
         self.t_eval_wait_total = 0.0
         self.t_refit_total = 0.0
+        self.t_compile_total = 0.0
 
         if resume and archive and os.path.exists(archive):
             self._resume(archive)
@@ -437,10 +462,12 @@ class Tuner:
         for both the donating default and the non-donating variant
         forwarding-state arms fall back to."""
         sp, nb = self.space, t.natural_batch(self.space)
-        return jax.jit(
-            lambda st, c, q, best, _t=t, _b=nb:
-            _t.observe(sp, st, c[:_b], q[:_b], best),
-            donate_argnums=(0,) if donate else ())
+        return obs.instrument_device_fn(
+            jax.jit(
+                lambda st, c, q, best, _t=t, _b=nb:
+                _t.observe(sp, st, c[:_b], q[:_b], best),
+                donate_argnums=(0,) if donate else ()),
+            f"driver.observe.{t.name}")
 
     def _space_sig(self) -> List[str]:
         """Ordered structural signature of the space (Space.signature):
@@ -687,6 +714,10 @@ class Tuner:
         sm = self.surrogate
         if not self._surrogate_ready():
             return None
+        # compile-window baseline BEFORE the pool/dedup dispatches, so
+        # a first-pull compile on this path lands in this ticket's
+        # StepStats window (same rule as _acquire's dev0)
+        dev0 = obs.device.compile_totals()
         self.key, k = jax.random.split(self.key)
         cands = sm.propose_pool(k, self.best.u, self.best.perms,
                                 float(self.best.qor))
@@ -698,7 +729,8 @@ class Tuner:
             return None
         self._arm_dry.pop("surrogate", None)
         tk = self._open_injected_ticket(cands, "surrogate", _pre=pre,
-                                        credit_virtual=credit)
+                                        credit_virtual=credit,
+                                        dev0=dev0)
         if not tk.trials:
             # every novel row was rejected by the user's config filter:
             # the pull produced nothing to evaluate.  Treated like pool
@@ -746,18 +778,26 @@ class Tuner:
                 np.asarray(src), novel_np, packed)
 
     def _open_injected_ticket(self, cands: CandBatch, source: str,
-                              _pre=None, credit_virtual=False) -> _Ticket:
+                              _pre=None, credit_virtual=False,
+                              dev0=None) -> _Ticket:
         """Dedup -> pending-mask -> injected ticket -> open: the shared
         plumbing behind inject() and the surrogate proposal plane.
         Injected tickets never touch technique states; they skip bandit
         credit too unless credit_virtual (the bandit-arbitrated
-        surrogate arm)."""
+        surrogate arm).  `dev0` is the caller's pre-dispatch compile
+        baseline when it already ran device work for this ticket
+        (`_pre`); otherwise it is captured here, before the dedup
+        dispatch, so a first-ever driver.dedup compile lands in THIS
+        ticket's StepStats window."""
+        if dev0 is None:
+            dev0 = obs.device.compile_totals()
         hashes, known, src, novel_np, packed = (
             _pre if _pre is not None else self._dedup_masked(cands))
         tk = _Ticket(None, source, None, cands, hashes, known, src,
                      novel_np, injected=True, pruned=0,
                      credit_virtual=credit_virtual)
         tk.packed = packed
+        tk.dev0 = dev0
         self._open_ticket(tk)
         return tk
 
@@ -765,6 +805,7 @@ class Tuner:
         """Choose arm -> propose batch -> dedup (history + in-batch +
         pending) -> surrogate prune; returns the open ticket."""
         self._acq_count += 1
+        dev0 = obs.device.compile_totals()
         if not self._surr_arm:
             tk = self._acquire_surrogate()
             if tk is not None:
@@ -880,6 +921,7 @@ class Tuner:
                      gen=self._tgen.get(t.name, 0))
         tk.packed = packed
         tk.t_propose = t_prop
+        tk.dev0 = dev0
         self._open_ticket(tk)
         tk.t_dedup = time.perf_counter() - t_host0 - t_prop
         return tk
@@ -1262,10 +1304,19 @@ class Tuner:
         sm = self.surrogate
         snap_v = int(getattr(sm, "snapshot_version", 0) or 0)
         lag = int(getattr(sm, "refit_lag_rows", 0) or 0)
+        # device-plane compile activity over this ticket's window
+        # (zeros while tracing is off; concurrent tickets attribute a
+        # shared compile to each open window — a window report, not an
+        # exclusive cost split)
+        dc1, ds1 = obs.device.compile_totals()
+        n_compiles = dc1 - tk.dev0[0]
+        t_compile = ds1 - tk.dev0[1]
+        self.t_compile_total += t_compile
         stats = StepStats(self.steps, tk.arm_name, tk.cands.batch,
                           evaluated, self.sign * new, was_new_best,
                           tk.pruned, dropped, tk.t_propose, tk.t_dedup,
-                          t_wait, t_refit, snap_v, lag)
+                          t_wait, t_refit, snap_v, lag,
+                          n_compiles, t_compile)
         if jn:
             self._journal_step(tk, live, evaluated, withdrawn,
                                was_new_best, nb_flags, new, dropped,
@@ -1477,7 +1528,7 @@ class Tuner:
         return TuneResult(cfg, self.sign * q, self.evals, self.steps,
                           list(self.trace), self.t_propose_total,
                           self.t_dedup_total, self.t_eval_wait_total,
-                          self.t_refit_total)
+                          self.t_refit_total, self.t_compile_total)
 
     def best_config(self) -> Dict[str, Any]:
         return self.result().best_config

@@ -256,7 +256,7 @@ class BatchedEngine:
         fn = obs.instrument_device_fn(
             jax.jit(_run, donate_argnums=(0,) if donate else ()),
             "engine.batched_run", steps=n_steps,
-            n_instances=self.n_instances)
+            n_instances=self.n_instances, donate=donate)
         self._compiled[sig] = fn
         return fn
 

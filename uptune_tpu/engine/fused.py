@@ -212,8 +212,11 @@ class FusedEngine:
         fn = jax.jit(_run, donate_argnums=(0,) if donate else ())
         # each dispatch of the fused step loop is one span on the
         # caller's lane (and a jax.profiler.TraceAnnotation, so a
-        # captured XLA profile lines up with the host trace)
-        return obs.instrument_device_fn(fn, "engine.run", steps=n_steps)
+        # captured XLA profile lines up with the host trace); a traced
+        # run also harvests the program's XLA cost/memory analysis at
+        # compile time (obs.device, docs/OBSERVABILITY.md)
+        return obs.instrument_device_fn(fn, "engine.run",
+                                        steps=n_steps, donate=donate)
 
     def run_traced(self, state: EngineState,
                    n_steps: int) -> Tuple[EngineState, jax.Array]:

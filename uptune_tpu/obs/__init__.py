@@ -27,7 +27,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-from . import flight, journal, quality
+from . import device, flight, journal, quality
 from .core import (DEFAULT_CAPACITY, complete_span, device_span,
                    disable, emit_at, enable, enabled, event,
                    new_span_id, now, reset, snapshot, span,
@@ -48,7 +48,7 @@ __all__ = [
     "maybe_enable_from_env", "finish", "start_flight_recorder",
     "install_exit_flush", "instrument_device_fn", "DEFAULT_CAPACITY",
     "journal", "quality", "start_journal", "stop_journal",
-    "maybe_journal_from_env",
+    "maybe_journal_from_env", "device",
 ]
 
 
@@ -86,21 +86,14 @@ def instrument_device_fn(fn, name: str, **attrs):
     `device_span` (host span + jax.profiler.TraceAnnotation) — the
     engine plane's seam: the whole fused/batched step loop is ONE
     compiled program, so its observability unit is the dispatch call.
-    The `.lower` attribute is forwarded for AOT compile / cost-analysis
-    paths (bench.py); when tracing is disabled the wrapper costs one
-    flag check."""
-    import functools
-
-    @functools.wraps(fn)
-    def wrapper(*a, **kw):
-        if not enabled():
-            return fn(*a, **kw)
-        with device_span(name, **attrs):
-            return fn(*a, **kw)
-
-    if hasattr(fn, "lower"):
-        wrapper.lower = fn.lower
-    return wrapper
+    Since ISSUE 13 the wrapper is also the device-telemetry harvest
+    point (`obs.device`): a program first dispatched while tracing is
+    on compiles under an `engine.compile` span (persistent-cache
+    hit/miss attributed) and publishes its XLA cost/memory analysis
+    as `device.*` gauges.  The `.lower` attribute is forwarded for
+    AOT compile / cost-analysis paths (bench.py); when tracing is
+    disabled the wrapper costs one flag check."""
+    return device.instrument(fn, name, **attrs)
 
 
 def maybe_enable_from_env(env: Optional[dict] = None) -> Optional[str]:
@@ -184,6 +177,14 @@ def _flush_all(reason: str) -> None:
         # the tuning journal's buffered tail rides the same graceful
         # flush: an interrupted run keeps its search telemetry too
         journal.flush()
+        # an active jax.profiler capture must also settle, or the
+        # XPlane dump is lost on exactly the failed/^C runs one most
+        # wants to profile (stop_trace is idempotent-safe when no
+        # capture is active)
+        try:
+            device.stop_trace()
+        except Exception:
+            pass
     finally:
         _FLUSH_STATE["flushing"] = False
 

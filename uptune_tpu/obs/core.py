@@ -127,8 +127,10 @@ def enable(capacity: int = DEFAULT_CAPACITY) -> None:
     _T0 = time.perf_counter()
     _T0_UNIX = time.time()
     _ENABLED = True
+    from . import device as _d
     from . import metrics as _m
     _m.reset()
+    _d.reset_registry()
 
 
 def disable() -> None:
@@ -143,8 +145,10 @@ def reset() -> None:
     with _REG_LOCK:
         _RINGS.clear()
         _EPOCH += 1
+    from . import device as _d
     from . import metrics as _m
     _m.reset()
+    _d.reset_registry()
 
 
 def now() -> float:

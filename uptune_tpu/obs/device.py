@@ -1,0 +1,492 @@
+"""Device-plane telemetry: compile/cost/roofline observability for
+every engine program (ISSUE 13).
+
+The obs plane watches the host system (spans, metrics, distributed
+traces) and the search itself (journal, quality gauges); this module
+watches the DEVICE — what each compiled engine program costs in
+FLOPs/bytes/memory, how long its compiles took (and whether the
+persistent XLA compile cache served them), and how the achieved
+rates over measured step windows sit against the chip's published
+roofline peaks.  Three layers:
+
+* **Harvest** — `harvest(compiled)` reads XLA's own
+  ``cost_analysis()`` + ``memory_analysis()`` for a compiled program:
+  flops, bytes accessed, transcendentals, and peak temp/argument/
+  output/code memory.  `instrument(fn, name)` (the implementation
+  behind ``obs.instrument_device_fn``, the seam already wrapping
+  FusedEngine/BatchedEngine ``jit_run`` and the driver's per-arm
+  programs) harvests automatically at compile time: the first traced
+  call lowers + compiles the program under an ``engine.compile`` span
+  (with persistent compile-cache hit/miss attribution from
+  ``jax.monitoring`` events) and reuses the AOT executable for every
+  later dispatch — same single trace, same compile, plus the cost
+  model read while the compiler state is in hand.
+* **Registry + gauges** — per-program records (`programs()`) publish
+  ``device.*`` counters/gauges into ``obs.metrics``, so the flight
+  recorder, the Prometheus exposition, the serve metrics scrape,
+  ``ut top``'s device panel, and ``ut report``'s "Device & compile"
+  section all carry them for free.  `record_window(name, wall_s)`
+  turns a MEASURED step window (caller-blocked wall, as bench.py
+  records) into achieved flops/s + HBM B/s and MXU/HBM utilization
+  against `PEAKS` — the per-platform peak table promoted out of
+  bench.py.  Dispatch-window rates are also published per call; they
+  are an upper bound for async callers (the dispatch may return
+  before the device finishes), so artifact numbers come from
+  `record_window` over explicitly blocked reps.
+* **Profiler capture** — `start_trace(dir)` / `stop_trace()` wrap
+  ``jax.profiler`` so ``ut --device-trace DIR`` / ``UT_DEVICE_TRACE``
+  dump an XPlane profile whose directory is referenced from the
+  Chrome-trace export (``otherData.device_trace``): host spans and
+  XLA kernels land in one combined Perfetto view
+  (docs/OBSERVABILITY.md "Device telemetry").
+
+Disabled is free, same contract as the rest of the package: every
+entry point checks the core enabled flag first; the disabled
+instrument path is one flag check + one dict write and returns the
+shared no-op singleton's behavior (no spans, no metrics, no
+registry).  jax itself is imported lazily — importing obs must not
+initialize a backend.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from . import core, metrics
+
+__all__ = [
+    "PEAKS", "resolve_peaks", "utilization", "harvest",
+    "validate_record", "instrument", "record_window", "programs",
+    "compile_totals", "reset_registry", "start_trace", "stop_trace",
+    "trace_dir", "maybe_trace_from_env",
+]
+
+# Published per-chip peaks for roofline estimates, promoted out of
+# bench.py (ISSUE 13): substring of device_kind -> (peak flops/s,
+# peak HBM B/s).  Upper bounds from public per-chip specs; the bf16
+# MXU peak is quoted even though the engines run f32, so a flops
+# utilization read against it is a conservative lower bound on
+# achievable MFU.  Unknown devices (CPU, future chips) resolve to
+# None and get NO utilization claims — an estimate against a made-up
+# peak would be worse than silence.
+PEAKS: Dict[str, Tuple[float, float]] = {
+    "v6": (918e12, 1640e9),
+    "v5p": (459e12, 2765e9),
+    "v5e": (197e12, 819e9),
+    "v5 lite": (197e12, 819e9),
+    "v4": (275e12, 1200e9),
+    "v3": (123e12, 900e9),
+    "v2": (45e12, 700e9),
+}
+
+
+def resolve_peaks(device_kind: Optional[str]
+                  ) -> Optional[Tuple[float, float]]:
+    """(peak_flops_per_s, peak_hbm_bytes_per_s) for a device_kind, by
+    case-insensitive substring match against `PEAKS`; None when the
+    device is unknown (no roofline claims for it)."""
+    kind = (device_kind or "").lower()
+    for sub, peaks in PEAKS.items():
+        if sub in kind:
+            return peaks
+    return None
+
+
+def utilization(device_kind: Optional[str],
+                flops_per_s: Optional[float] = None,
+                bytes_per_s: Optional[float] = None) -> Dict[str, Any]:
+    """Roofline utilization vs the published per-chip peaks — the
+    shape bench.py's artifacts carry: empty for unknown devices,
+    peaks always present for known ones, `mxu_util`/`hbm_util` when
+    the achieved rates are given."""
+    peaks = resolve_peaks(device_kind)
+    if peaks is None:
+        return {}
+    pf, pb = peaks
+    out: Dict[str, Any] = {"peak_flops_per_s": pf,
+                           "peak_hbm_bytes_per_s": pb}
+    if flops_per_s:
+        out["mxu_util"] = round(flops_per_s / pf, 6)
+    if bytes_per_s:
+        out["hbm_util"] = round(bytes_per_s / pb, 4)
+    return out
+
+
+# ------------------------------------------------------------ harvest
+def harvest(compiled) -> Dict[str, Any]:
+    """XLA's cost + memory analysis for one compiled program.
+
+    Always returns the full schema (`validate_record`); fields the
+    backend doesn't expose are None.  ``flops`` / ``bytes_accessed``
+    come from the compiler's cost model over the whole program;
+    ``peak_memory`` is the executable's own allocation plan
+    (temp/argument/output/generated-code bytes)."""
+    rec: Dict[str, Any] = {"flops": None, "bytes_accessed": None,
+                           "transcendentals": None, "peak_memory": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):   # one entry per computation
+            ca = ca[0] if ca else {}
+        for field, key in (("flops", "flops"),
+                           ("bytes_accessed", "bytes accessed"),
+                           ("transcendentals", "transcendentals")):
+            v = ca.get(key)
+            if v:
+                rec[field] = float(v)
+    except Exception:       # backend-dependent: absent, not an error
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        rec["peak_memory"] = {
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception:
+        pass
+    if rec["flops"] and rec["bytes_accessed"]:
+        rec["arith_intensity"] = round(
+            rec["flops"] / rec["bytes_accessed"], 6)
+    else:
+        rec["arith_intensity"] = None
+    return rec
+
+
+_MEM_KEYS = ("temp_bytes", "argument_bytes", "output_bytes",
+             "alias_bytes", "generated_code_bytes")
+
+
+def validate_record(rec: Any) -> None:
+    """Schema contract for a harvested cost record (raises ValueError)
+    — what tests and artifact consumers hold `harvest` output to."""
+    def fail(msg):
+        raise ValueError(f"device record schema: {msg}")
+
+    if not isinstance(rec, dict):
+        fail("record must be a dict")
+    for k in ("flops", "bytes_accessed", "transcendentals",
+              "arith_intensity"):
+        if k not in rec:
+            fail(f"missing {k!r}")
+        v = rec[k]
+        if v is not None and (not isinstance(v, (int, float))
+                              or v < 0):
+            fail(f"{k!r} must be a non-negative number or None")
+    if "peak_memory" not in rec:
+        fail("missing 'peak_memory'")
+    pm = rec["peak_memory"]
+    if pm is not None:
+        if not isinstance(pm, dict):
+            fail("'peak_memory' must be a dict or None")
+        for k in _MEM_KEYS:
+            if not isinstance(pm.get(k), int) or pm[k] < 0:
+                fail(f"peak_memory.{k} must be a non-negative int")
+
+
+# ----------------------------------------------------------- registry
+_LOCK = threading.Lock()
+_PROGRAMS: Dict[str, Dict[str, Any]] = {}
+_COMPILES = 0           # process totals (read without the lock: two
+_COMPILE_S = 0.0        # GIL-atomic reads for StepStats deltas)
+_TLS = threading.local()   # .program: name being compiled right now
+_LISTENER = {"installed": False}
+
+
+def _program(name: str) -> Dict[str, Any]:
+    rec = _PROGRAMS.get(name)
+    if rec is None:
+        rec = _PROGRAMS[name] = {
+            "name": name, "cost": None, "compiles": 0,
+            "compile_s": 0.0, "cache": None, "cache_hits": 0,
+            "cache_misses": 0, "dispatches": 0, "dispatch_s": 0.0,
+        }
+    return rec
+
+
+def programs() -> Dict[str, Dict[str, Any]]:
+    """Per-program telemetry records (copies): harvested cost/memory,
+    compile count/time, cache attribution, dispatch totals."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _PROGRAMS.items()}
+
+
+def compile_totals() -> Tuple[int, float]:
+    """(compile count, compile seconds) since enable — the cheap
+    getter driver StepStats reads deltas of (0 when telemetry never
+    ran)."""
+    return _COMPILES, _COMPILE_S
+
+
+def reset_registry() -> None:
+    global _COMPILES, _COMPILE_S
+    with _LOCK:
+        _PROGRAMS.clear()
+        _COMPILES = 0
+        _COMPILE_S = 0.0
+
+
+def _on_monitoring_event(event: str, **kw) -> None:
+    """jax.monitoring listener: persistent compile-cache hits/misses,
+    attributed to the program whose harvest compile is running on this
+    thread (or to '(other)' for compiles outside the instrument seam:
+    surrogate fits, user programs)."""
+    if not core._ENABLED:
+        return
+    if event.endswith("/cache_hits"):
+        kind = "cache_hits"
+    elif event.endswith("/cache_misses"):
+        kind = "cache_misses"
+    else:
+        return
+    name = getattr(_TLS, "program", None) or "(other)"
+    metrics.count(f"device.compile_{kind}")
+    with _LOCK:
+        _program(name)[kind] += 1
+
+
+def _install_listener() -> None:
+    """Register the cache-event listener ONCE per process (the jax
+    monitoring registry has no unregister; the callback is inert while
+    tracing is off)."""
+    if _LISTENER["installed"]:
+        return
+    _LISTENER["installed"] = True
+    try:
+        from jax import monitoring
+        monitoring.register_event_listener(_on_monitoring_event)
+    except Exception:
+        pass        # older jax without monitoring: attribution absent
+
+
+def _publish_cost(name: str, rec: Dict[str, Any]) -> None:
+    cost = rec.get("cost") or {}
+    if cost.get("flops"):
+        metrics.gauge(f"device.flops.{name}", cost["flops"])
+    if cost.get("bytes_accessed"):
+        metrics.gauge(f"device.bytes.{name}", cost["bytes_accessed"])
+    if cost.get("arith_intensity"):
+        metrics.gauge(f"device.arith_intensity.{name}",
+                      cost["arith_intensity"])
+    pm = cost.get("peak_memory")
+    if pm:
+        metrics.gauge(f"device.mem_temp_bytes.{name}", pm["temp_bytes"])
+        metrics.gauge(f"device.mem_arg_bytes.{name}",
+                      pm["argument_bytes"])
+        metrics.gauge(f"device.mem_out_bytes.{name}",
+                      pm["output_bytes"])
+    metrics.gauge(f"device.compile_ms.{name}",
+                  round(rec["compile_s"] * 1e3, 3))
+    with _LOCK:
+        metrics.gauge("device.programs", len(_PROGRAMS))
+
+
+def _harvest_compiled(name: str, fn, args, kwargs):
+    """First traced call of an instrumented program: lower + compile
+    it AOT under an `engine.compile` span, harvest the cost model,
+    attribute the persistent-cache outcome, publish gauges.  Returns
+    the compiled executable (reused for every later dispatch — the
+    lowering IS the program's one trace), or None when the program
+    can't take the AOT path (no .lower, lowering failed)."""
+    global _COMPILES, _COMPILE_S
+    _install_listener()
+    try:
+        lowered = fn.lower(*args, **kwargs)
+    except Exception:
+        return None
+    _TLS.program = name
+    h0, m0 = None, None
+    with _LOCK:
+        rec = _program(name)
+        h0, m0 = rec["cache_hits"], rec["cache_misses"]
+    t0 = time.perf_counter()
+    with core.span("engine.compile", program=name) as sp:
+        try:
+            compiled = lowered.compile()
+        except Exception:
+            _TLS.program = None
+            return None
+        dur = time.perf_counter() - t0
+        _TLS.program = None
+        cost = harvest(compiled)
+        with _LOCK:
+            rec = _program(name)
+            rec["cost"] = cost
+            rec["compiles"] += 1
+            rec["compile_s"] += dur
+            dh = rec["cache_hits"] - h0
+            dm = rec["cache_misses"] - m0
+            # one compile usually consults the cache once; a hit that
+            # also missed sub-computations still counts as a miss (the
+            # big executable was built, not loaded)
+            rec["cache"] = ("miss" if dm else
+                            "hit" if dh else "off")
+            _COMPILES += 1
+            _COMPILE_S += dur
+        sp.set(ms=round(dur * 1e3, 3), cache=rec["cache"],
+               flops=cost.get("flops"),
+               bytes=cost.get("bytes_accessed"))
+    metrics.count("device.compiles")
+    metrics.observe("device.compile_ms", dur * 1e3)
+    _publish_cost(name, rec)
+    return compiled
+
+
+def _record_dispatch(name: str, dur: float) -> None:
+    metrics.count("device.dispatches")
+    metrics.observe("device.dispatch_ms", dur * 1e3)
+    with _LOCK:
+        rec = _program(name)
+        rec["dispatches"] += 1
+        rec["dispatch_s"] += dur
+
+
+def _device_kind() -> str:
+    try:
+        import jax
+        return getattr(jax.devices()[0], "device_kind", "") or ""
+    except Exception:
+        return ""
+
+
+def record_window(name: str, wall_s: float,
+                  device_kind: Optional[str] = None) -> Dict[str, Any]:
+    """Publish achieved-rate + utilization gauges for one MEASURED
+    step window of program `name` (caller-blocked wall seconds, the
+    honest denominator — bench.py blocks around its reps and calls
+    this).  Returns the computed fields; no-op-empty when telemetry
+    is off or the program has no harvested cost."""
+    if not core._ENABLED or wall_s <= 0:
+        return {}
+    with _LOCK:
+        rec = _PROGRAMS.get(name)
+        cost = dict(rec["cost"]) if rec and rec.get("cost") else None
+    if not cost:
+        return {}
+    kind = _device_kind() if device_kind is None else device_kind
+    out: Dict[str, Any] = {}
+    flops, nbytes = cost.get("flops"), cost.get("bytes_accessed")
+    if flops:
+        out["achieved_flops_per_s"] = flops / wall_s
+    if nbytes:
+        out["achieved_hbm_bytes_per_s"] = nbytes / wall_s
+    out.update(utilization(kind, out.get("achieved_flops_per_s"),
+                           out.get("achieved_hbm_bytes_per_s")))
+    if cost.get("arith_intensity"):
+        out["arith_intensity"] = cost["arith_intensity"]
+    for k, v in out.items():
+        metrics.gauge(f"device.{k}.{name}", v)
+        metrics.gauge(f"device.{k}", v)     # aggregate: last window
+    return out
+
+
+# --------------------------------------------------------- instrument
+def instrument(fn, name: str, **attrs):
+    """Wrap a jitted callable for device telemetry — the
+    implementation behind ``obs.instrument_device_fn``.
+
+    Disabled path: one flag check (plus remembering the program went
+    warm, so a later enable never re-traces it).  Enabled path: the
+    program's FIRST call takes the AOT route (`_harvest_compiled`) —
+    lower once (the same single trace a direct call would cost),
+    compile under an `engine.compile` span with cache attribution,
+    harvest the cost model — and every call dispatches under a
+    `device_span` with dispatch totals recorded.  A program already
+    warmed while telemetry was off is dispatch-tracked only (lowering
+    it again would be a second trace — the strict trace-guard
+    contract outranks a late harvest).  `.lower` is forwarded from
+    the original wrapper for explicit AOT/bench paths."""
+    st = {"warm": False, "compiled": None}
+
+    @functools.wraps(fn)
+    def wrapper(*a, **kw):
+        if not core._ENABLED:
+            st["warm"] = True
+            return fn(*a, **kw)
+        call = st["compiled"]
+        if call is None:
+            if not st["warm"] and hasattr(fn, "lower"):
+                st["compiled"] = call = _harvest_compiled(
+                    name, fn, a, kw)
+            st["warm"] = True
+            if call is None:
+                call = fn
+        t0 = time.perf_counter()
+        with core.device_span(name, **attrs):
+            try:
+                out = call(*a, **kw)
+            except TypeError:
+                if call is fn:
+                    raise
+                # aval drift: the AOT executable was compiled for
+                # different input types — fall back to the jit
+                # wrapper (which re-specializes) for this and every
+                # later call
+                st["compiled"] = None
+                st["warm"] = True
+                out = fn(*a, **kw)
+        _record_dispatch(name, time.perf_counter() - t0)
+        return out
+
+    if hasattr(fn, "lower"):
+        wrapper.lower = fn.lower
+    return wrapper
+
+
+# ------------------------------------------------- profiler capture
+_TRACE = {"dir": None, "active": False}
+
+
+def start_trace(out_dir: str) -> Optional[str]:
+    """Programmatic ``jax.profiler`` capture into `out_dir` (the
+    ``ut --device-trace DIR`` / ``UT_DEVICE_TRACE`` path).  The
+    XPlane dump lands under ``<dir>/plugins/profile/...`` and the
+    directory is referenced from the Chrome-trace export
+    (``otherData.device_trace``) so the two open side by side in
+    Perfetto.  Returns the directory, or None when the profiler is
+    unavailable.  Idempotent while a capture is active."""
+    if _TRACE["active"]:
+        return _TRACE["dir"]
+    try:
+        import jax
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+    except Exception:
+        return None
+    _TRACE["dir"] = out_dir
+    _TRACE["active"] = True
+    return out_dir
+
+
+def stop_trace() -> Optional[str]:
+    """Stop an active profiler capture; returns its directory (kept
+    as `trace_dir()` so a later export still references the dump)."""
+    if not _TRACE["active"]:
+        return None
+    _TRACE["active"] = False
+    try:
+        import jax
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+    return _TRACE["dir"]
+
+
+def trace_dir() -> Optional[str]:
+    """Directory of the active (or last finished) profiler capture in
+    this process — what the Chrome-trace export references."""
+    return _TRACE["dir"]
+
+
+def maybe_trace_from_env(env: Optional[dict] = None) -> Optional[str]:
+    """``UT_DEVICE_TRACE=<dir>`` starts a profiler capture for this
+    process (the CLI's ``--device-trace`` flag layers above it)."""
+    e = os.environ if env is None else env
+    val = e.get("UT_DEVICE_TRACE", "").strip()
+    if not val or val.lower() in ("0", "off", "false", "none"):
+        return None
+    return start_trace(val)

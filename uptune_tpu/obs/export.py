@@ -87,6 +87,13 @@ def chrome_trace(snap: Optional[Dict[str, Any]] = None,
         "dropped": snap.get("dropped", {}),
         "metrics": metrics.snapshot(),
     }
+    # combined-profile reference (ISSUE 13): when a programmatic
+    # jax.profiler capture ran (`ut --device-trace` / UT_DEVICE_TRACE),
+    # point at its XPlane dump dir so the host trace and the XLA
+    # kernel profile open side by side (docs/OBSERVABILITY.md)
+    from . import device as _device
+    if _device.trace_dir():
+        other["device_trace"] = _device.trace_dir()
     if extra:
         other.update(extra)
     return {"traceEvents": events, "displayTimeUnit": "ms",

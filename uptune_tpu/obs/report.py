@@ -33,7 +33,7 @@ from . import journal as journal_mod
 from . import quality as quality_mod
 
 __all__ = ["analyze", "render", "render_html", "render_markdown",
-           "summarize_metrics", "main"]
+           "summarize_metrics", "device_summary", "main"]
 
 # nominal two-sided central-interval levels for the reliability table
 # (z quantiles of the standard normal)
@@ -159,7 +159,54 @@ def summarize_metrics(metrics_path: str) -> Optional[Dict[str, Any]]:
             "span_s": round(rows[-1].get("t", 0) - rows[0].get("t", 0),
                             3),
             "final_counters": rows[-1].get("counters", {}),
+            "final_gauges": rows[-1].get("gauges", {}),
+            "final_hists": rows[-1].get("hists", {}),
             "peak_rates": {k: round(v, 2) for k, v in top}}
+
+
+# device-telemetry extraction (ISSUE 13): the same replay path as the
+# rest of the report — the flight recorder's FINAL row carries the
+# run's terminal device.* gauges/counters, exactly what `ut top`
+# showed live, so the report can never disagree with the dashboard
+_DEV_PROGRAM_FAMILIES = ("flops", "bytes", "compile_ms",
+                         "arith_intensity")
+_DEV_ROOFLINE_KEYS = ("achieved_flops_per_s",
+                      "achieved_hbm_bytes_per_s", "peak_flops_per_s",
+                      "peak_hbm_bytes_per_s", "mxu_util", "hbm_util",
+                      "arith_intensity")
+
+
+def device_summary(met: Optional[Dict[str, Any]]
+                   ) -> Optional[Dict[str, Any]]:
+    """Per-program flops/bytes, the compile breakdown and the roofline
+    aggregates from a metrics timeline's final row; None when the run
+    carried no device telemetry (the section is simply absent)."""
+    if not met:
+        return None
+    g = met.get("final_gauges") or {}
+    c = met.get("final_counters") or {}
+    if not any(k.startswith("device.") for k in list(g) + list(c)):
+        return None
+    progs: Dict[str, Dict[str, Any]] = {}
+    for fam in _DEV_PROGRAM_FAMILIES:
+        prefix = f"device.{fam}."
+        for k, v in g.items():
+            if k.startswith(prefix):
+                progs.setdefault(k[len(prefix):], {})[fam] = v
+    h = (met.get("final_hists") or {}).get("device.compile_ms") or {}
+    return {
+        "programs": progs,
+        "compile": {
+            "compiles": c.get("device.compiles"),
+            "compile_ms_total": h.get("sum"),
+            "cache_hits": c.get("device.compile_cache_hits"),
+            "cache_misses": c.get("device.compile_cache_misses"),
+            "dispatches": c.get("device.dispatches"),
+        },
+        "roofline": {k: g.get(f"device.{k}")
+                     for k in _DEV_ROOFLINE_KEYS
+                     if g.get(f"device.{k}") is not None},
+    }
 
 
 # --------------------------------------------------------------- SVG
@@ -383,6 +430,32 @@ def render_markdown(an: Dict[str, Any],
                   "| counter | peak rate /s |", "|---|---|"]
         for k, v in met["peak_rates"].items():
             lines.append(f"| {k} | {v} |")
+    dev = device_summary(met)
+    if dev:
+        lines += ["", "## Device & compile", ""]
+        comp = dev["compile"]
+        lines += ["| metric | value |", "|---|---|"]
+        for label, key in (("compiles", "compiles"),
+                           ("compile time (ms)", "compile_ms_total"),
+                           ("compile-cache hits", "cache_hits"),
+                           ("compile-cache misses", "cache_misses"),
+                           ("device dispatches", "dispatches")):
+            lines.append(f"| {label} | {_fmt(comp.get(key))} |")
+        if dev["programs"]:
+            lines += ["", "| program | flops | bytes | AI | "
+                          "compile ms |", "|---|---|---|---|---|"]
+            for name in sorted(dev["programs"]):
+                p = dev["programs"][name]
+                lines.append(
+                    f"| {name} | {_fmt(p.get('flops'))} | "
+                    f"{_fmt(p.get('bytes'))} | "
+                    f"{_fmt(p.get('arith_intensity'), 3)} | "
+                    f"{_fmt(p.get('compile_ms'), 3)} |")
+        if dev["roofline"]:
+            lines += ["", "| roofline (last measured window) | value |",
+                      "|---|---|"]
+            for k in sorted(dev["roofline"]):
+                lines.append(f"| {k} | {_fmt(dev['roofline'][k])} |")
     return "\n".join(lines) + "\n"
 
 
@@ -508,6 +581,32 @@ def render_html(an: Dict[str, Any],
                         sorted(met["peak_rates"].items())),
                   f"<p class='meta'>{met['rows']} rows over "
                   f"{met['span_s']} s</p>"]
+    dev = device_summary(met)
+    if dev:
+        comp = dev["compile"]
+        parts += ["<h2>Device &amp; compile</h2>",
+                  table(("metric", "value"),
+                        [("compiles", _fmt(comp.get("compiles"))),
+                         ("compile time (ms)",
+                          _fmt(comp.get("compile_ms_total"))),
+                         ("compile-cache hits",
+                          _fmt(comp.get("cache_hits"))),
+                         ("compile-cache misses",
+                          _fmt(comp.get("cache_misses"))),
+                         ("device dispatches",
+                          _fmt(comp.get("dispatches")))])]
+        if dev["programs"]:
+            parts.append(table(
+                ("program", "flops", "bytes", "AI", "compile ms"),
+                [(name, _fmt(p.get("flops")), _fmt(p.get("bytes")),
+                  _fmt(p.get("arith_intensity"), 3),
+                  _fmt(p.get("compile_ms"), 3))
+                 for name, p in sorted(dev["programs"].items())]))
+        if dev["roofline"]:
+            parts.append(table(
+                ("roofline (last measured window)", "value"),
+                [(k, _fmt(dev["roofline"][k]))
+                 for k in sorted(dev["roofline"])]))
     parts.append("</body></html>")
     return "".join(parts)
 

@@ -180,6 +180,25 @@ def render(prev: Optional[Sample], cur: Sample, source: str,
             _fmt(g.get("surrogate.refit_lag_rows"), nd=0),
             _fmt(c.get("serve.new_bests", c.get("driver.new_bests")),
                  nd=0)),
+        # device panel (ISSUE 13): compile count/time + persistent-
+        # cache outcome from the obs.device counters, achieved rates
+        # and util fractions from the last measured window's aggregate
+        # gauges — all "—" for an untraced / pre-ISSUE-13 stream
+        "device    programs {}   compiles {} ({} ms)   "
+        "cache hit/miss {}/{}   dispatches/s {}".format(
+            _fmt(g.get("device.programs"), nd=0),
+            _fmt(c.get("device.compiles"), nd=0),
+            _fmt(_hist_p(h, "device.compile_ms", "sum"), nd=0),
+            _fmt(c.get("device.compile_cache_hits"), nd=0),
+            _fmt(c.get("device.compile_cache_misses"), nd=0),
+            _fmt(r.get("device.dispatches"))),
+        "roofline  flops/s {}   HBM B/s {}   MXU {}   HBM {}   "
+        "AI {}".format(
+            _fmt(g.get("device.achieved_flops_per_s"), nd=0),
+            _fmt(g.get("device.achieved_hbm_bytes_per_s"), nd=0),
+            _fmt(g.get("device.mxu_util"), nd=6),
+            _fmt(g.get("device.hbm_util"), nd=4),
+            _fmt(g.get("device.arith_intensity"), nd=3)),
     ]
     # search-quality panel (ISSUE 12): the journal-derived gauges a
     # QualityMonitor publishes; a run without a journal renders "—"

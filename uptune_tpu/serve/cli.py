@@ -111,6 +111,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         trace_path = None
     if trace_path and not obs.enabled():
         obs.enable()
+    # UT_DEVICE_TRACE=<dir>: programmatic jax.profiler capture for the
+    # serving process (ISSUE 13) — stopped in the shutdown finally so
+    # a SIGINT'd server still settles its XPlane dump
+    dtrace = obs.device.maybe_trace_from_env()
     if trace_path:
         # a serving process is exactly the shape the flight recorder
         # exists for: long-lived, scraped rarely, killed by signal —
@@ -146,6 +150,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         srv.serve_forever()
     finally:
+        if dtrace:
+            obs.device.stop_trace()
+            log.info("[ut-serve] device profile captured under %s",
+                     dtrace)
         if journal_path:
             obs.stop_journal(mon)
             log.info("[ut-serve] journal written to %s (render with "
