@@ -1514,6 +1514,384 @@ def multi_main() -> None:
     print(json.dumps(result))
 
 
+def fleet_child_main() -> None:
+    """`bench.py --fleet-child`: one driver-replica source process of
+    the fleet-telemetry bench — a real library-Tuner ask/tell loop
+    with the obs plane, a flight recorder, AND a TelemetryShipper on,
+    so the parent can hold the hub's view of this source to the
+    source's own flight-recorder finals (the exactness contract)."""
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fleet-child", action="store_true")
+    ap.add_argument("--hub", required=True)
+    ap.add_argument("--role", required=True)
+    ap.add_argument("--metrics", required=True)
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--interval", type=float, default=0.15)
+    args, _ = ap.parse_known_args()
+
+    from uptune_tpu.utils.platform_guard import force_cpu
+    force_cpu(1)
+    from uptune_tpu import obs
+    from uptune_tpu.obs import flight, ship
+
+    obs.enable()
+    rec = flight.start(args.metrics, interval=args.interval)
+    shipper = ship.start(args.hub, role=args.role,
+                         interval=args.interval)
+
+    from uptune_tpu.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_space
+    tuner = Tuner(rosenbrock_space(8, -3.0, 3.0), None, seed=args.seed)
+    done = 0
+    while done < args.trials:
+        for tr in tuner.ask(min_trials=1):
+            # the driver_main deterministic dummy QoR stream
+            tuner.tell(tr, float((tr.gid * 2654435761) % 1000))
+            done += 1
+    tuner.close()
+    # final-window ordering: all metric activity is done, so the
+    # shipper's final window and the recorder's final row read the
+    # SAME terminal registry — the per-source equality the parent
+    # asserts (and tests/test_fleet.py unit-asserts)
+    shipper.stop()
+    rec.stop()
+    st = shipper.stats()
+    print(json.dumps({"ok": st["failures"] == 0 or st["acked"] > 0,
+                      "trials": done, **st}))
+
+
+def fleet_main() -> None:
+    """`bench.py --fleet`: the fleet-telemetry bench (ISSUE 14).
+
+    Phase 1 — shipper overhead: the BENCH_DRIVER ask/tell drain run
+    in alternating windows with the obs plane ON in both modes and a
+    TelemetryShipper to a live local hub added in the shipped
+    windows; best-of-reps ratio must hold the >= 0.95x bar (the
+    BENCH_OBS rule, priced for the shipping path).
+
+    Phase 2 — a real 4-process fleet against ONE hub: two driver
+    replicas (`--fleet-child` subprocesses), one `ut serve` process
+    (SIGTERM'd at the end, exercising the graceful final-window
+    flush), and this bench-client process itself, every one shipping
+    windows on its own (host, pid, role) key while also writing its
+    own flight recorder.  Asserts the EXACTNESS contract: the hub's
+    last window per source equals that source's final flight-recorder
+    row, so fleet counter sums equal the sum of per-source finals.
+
+    Phase 3 (full runs only) — the kill test: a third driver replica
+    is SIGKILLed mid-stream; the hub must retain every acked window
+    (all present in the durable timeline) and lose at most the one
+    un-acked in-flight window vs the dead process's on-disk flight
+    recorder.
+
+    Writes BENCH_FLEET.json (.quick.json for --quick)."""
+    quick = "--quick" in sys.argv
+    from uptune_tpu.utils.platform_guard import force_cpu
+    force_cpu(1)
+    import jax  # noqa: F401  (backend must init after force_cpu)
+
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+
+    from uptune_tpu import obs
+    from uptune_tpu.obs import hub as hub_mod
+    from uptune_tpu.obs import ship
+    from uptune_tpu.obs import top as top_mod
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="ut_fleet_bench_")
+    result: dict = {"metric": "fleet_telemetry", "quick": quick,
+                    "nproc": os.cpu_count()}
+
+    # ---- phase 1: shipper overhead on the driver hot path ------------
+    obs.enable()
+    from uptune_tpu.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_space
+    tuner = Tuner(rosenbrock_space(8, -3.0, 3.0), None, seed=0)
+
+    def drain(n):
+        done = 0
+        while done < n:
+            for tr in tuner.ask(min_trials=1):
+                tuner.tell(tr, float((tr.gid * 2654435761) % 1000))
+                done += 1
+
+    drain(200)      # warm: compile every arm + commit + observe
+    window = 400 if quick else 2000
+    reps = 1 if quick else 3
+    phase1_hub = hub_mod.TelemetryHub(port=0, timeline=None)
+    phase1_hub.start()
+
+    def timed(n):
+        t0 = time.perf_counter()
+        drain(n)
+        return n / (time.perf_counter() - t0)
+
+    unshipped, shipped = [], []
+    for rep in range(reps):
+        # rotate mode order per rep so co-tenant drift is uncorrelated
+        # with mode (the BENCH_OBS rule)
+        for mode in (("un", "sh") if rep % 2 == 0 else ("sh", "un")):
+            if mode == "un":
+                unshipped.append(timed(window))
+            else:
+                shipper = ship.TelemetryShipper(
+                    f"127.0.0.1:{phase1_hub.port}",
+                    role="bench-driver", interval=0.1)
+                shipper.start()
+                shipped.append(timed(window))
+                shipper.stop()
+    phase1_hub.stop()
+    tuner.close()
+    ratio = max(shipped) / max(unshipped)
+    result["phase1"] = {
+        "window_trials": window, "reps": reps,
+        "unshipped_asks_per_s": [round(r, 1) for r in unshipped],
+        "shipped_asks_per_s": [round(r, 1) for r in shipped],
+        "shipped_over_unshipped": round(ratio, 4),
+        "bar": 0.95, "bar_met": ratio >= 0.95,
+    }
+    print(f"bench --fleet: shipped/unshipped asks ratio "
+          f"{ratio:.4f} (bar 0.95)", file=sys.stderr)
+
+    # ---- phase 2: the 4-process fleet --------------------------------
+    timeline = os.path.join(workdir, "ut.fleet.jsonl")
+    hub = hub_mod.TelemetryHub(port=0, timeline=timeline,
+                               timeline_rotate=2)
+    hub.start()
+    addr = f"127.0.0.1:{hub.port}"
+    child_env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+
+    def _final_counters(metrics_path):
+        """Last (final) flight-recorder row's absolute counters."""
+        last = None
+        try:
+            with open(metrics_path) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(row, dict) and "counters" in row:
+                        last = row
+        except OSError:
+            return None
+        return (last or {}).get("counters")
+
+    n_trials = 80 if quick else 400
+    drivers = []
+    for i in range(2):
+        mpath = os.path.join(workdir, f"driver{i}.metrics.jsonl")
+        cmd = [sys.executable, os.path.join(repo, "bench.py"),
+               "--fleet-child", "--hub", addr,
+               "--role", f"ut-driver.h{i}", "--metrics", mpath,
+               "--trials", str(n_trials), "--seed", str(i),
+               "--interval", "0.15"]
+        p = subprocess.Popen(cmd, cwd=workdir, env=child_env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        drivers.append((p, mpath, f"ut-driver.h{i}"))
+
+    # one real `ut serve` process shipping its windows + health rollup
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    sport = s.getsockname()[1]
+    s.close()
+    serve_trace = os.path.join(workdir, "serve_trace.json")
+    serve_cmd = [sys.executable, "-m", "uptune_tpu.cli", "serve",
+                 "--port", str(sport), "--store-dir", "off",
+                 "--trace", serve_trace, "--metrics-interval", "0.15",
+                 "--telemetry", addr, "--work-dir", workdir]
+    serve_p = subprocess.Popen(serve_cmd, cwd=workdir, env=child_env,
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.STDOUT, text=True)
+
+    # this process is the 4th source: the bench client
+    from uptune_tpu.obs import flight
+    bench_metrics = os.path.join(workdir, "bench.metrics.jsonl")
+    bench_rec = flight.start(bench_metrics, interval=0.15)
+    bench_ship = ship.start(addr, role="bench", interval=0.15)
+
+    # wait for the server, then drive a small session through it
+    from uptune_tpu.serve.client import connect
+    deadline = time.time() + 120
+    client = None
+    while time.time() < deadline:
+        try:
+            # generous request timeout: the first open pays the
+            # group's trace+compile wall (seconds on a loaded box)
+            client = connect(("127.0.0.1", sport), timeout=180)
+            break
+        except OSError:
+            if serve_p.poll() is not None:
+                raise RuntimeError(
+                    "ut serve died: " + serve_p.communicate()[0][-2000:])
+            time.sleep(0.25)
+    if client is None:
+        raise RuntimeError("ut serve never came up")
+    sess = client.open_session(rosenbrock_space(2, -3.0, 3.0), seed=7,
+                               program="fleet-bench", store=False)
+    for _ in range(2 if quick else 8):
+        trials = sess.ask(4)
+        sess.tell_many(
+            (t.ticket, float(sum(v * v for v in t.config.values())))
+            for t in trials)
+    best = sess.best()
+    sess.close()
+    client.close()
+
+    rcs = []
+    for p, _, _ in drivers:
+        out = p.communicate()[0]
+        rcs.append(p.returncode)
+        if p.returncode != 0:
+            print(out[-2000:], file=sys.stderr)
+    if any(rcs):
+        raise RuntimeError(f"driver replicas failed: rcs={rcs}")
+    # SIGTERM the server: the graceful exit flush must ship its final
+    # window before the process dies (obs.install_exit_flush)
+    serve_p.terminate()
+    serve_p.wait(timeout=60)
+    time.sleep(0.4)     # let the hub fold the server's final batch
+    bench_ship.stop()
+    bench_rec.stop()
+
+    # ---- the exactness contract --------------------------------------
+    host = _socket.gethostname()
+    by_role = {s_.key[2]: s_ for s_ in hub._sources.values()
+               if s_.key[0] == host}
+    checks = []
+    fleet_expected: dict = {}
+    pairs = [(role, mpath) for _, mpath, role in drivers]
+    pairs += [("ut-serve", serve_trace + ".metrics.jsonl"),
+              ("bench", bench_metrics)]
+    for role, mpath in pairs:
+        src = by_role.get(role)
+        hub_counters = (src.last_window or {}).get("counters") \
+            if src is not None else None
+        file_counters = _final_counters(mpath)
+        ok = (hub_counters is not None
+              and hub_counters == file_counters)
+        checks.append({"role": role, "exact": ok,
+                       "hub_rows": src.acked if src else 0})
+        for k, v in (file_counters or {}).items():
+            fleet_expected[k] = fleet_expected.get(k, 0) + v
+    exact_ok = all(c["exact"] for c in checks)
+    roll = hub.handle({"op": "metrics"})["metrics"]
+    sum_ok = all(abs(roll["counters"].get(k, 0) - v) < 1e-9
+                 for k, v in fleet_expected.items())
+    health = hub.handle({"op": "health"})
+    # `ut top --addr <hub> --fleet` must render the live fleet (the
+    # acceptance criterion); the frame itself is test output, not
+    # bench output
+    import contextlib
+    import io
+    _sink = io.StringIO()
+    with contextlib.redirect_stdout(_sink):
+        top_frame_ok = top_mod.main(
+            ["--addr", addr, "--once", "--fleet", "--json"]) == 0
+    top_frame_ok = top_frame_ok and '"sources":' in _sink.getvalue()
+    result["phase2"] = {
+        "processes": 4, "hub_addr": addr,
+        "sources": hub.handle({"op": "sources"})["sources"],
+        "driver_trials_each": n_trials,
+        "serve_best_version": best.get("version"),
+        "per_source_exact": checks,
+        "all_sources_exact": exact_ok,
+        "fleet_counter_sum_exact": sum_ok,
+        "health_by_status": health["by_status"],
+        "timeline_rows": hub.rows_received,
+        "top_addr_fleet_frame": top_frame_ok,
+    }
+    print(f"bench --fleet: 4-process fleet exactness "
+          f"{'OK' if exact_ok and sum_ok else 'FAILED'} "
+          f"({hub.rows_received} timeline rows)", file=sys.stderr)
+
+    # ---- phase 3 (full only): the SIGKILL bound ----------------------
+    if not quick:
+        mpath = os.path.join(workdir, "victim.metrics.jsonl")
+        role = "ut-driver.victim"
+        cmd = [sys.executable, os.path.join(repo, "bench.py"),
+               "--fleet-child", "--hub", addr, "--role", role,
+               "--metrics", mpath, "--trials", "1000000",
+               "--seed", "9", "--interval", "0.1"]
+        p = subprocess.Popen(cmd, cwd=workdir, env=child_env,
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.DEVNULL)
+        deadline = time.time() + 180
+        victim = None
+        while time.time() < deadline:
+            victim = {s_.key[2]: s_ for s_ in
+                      hub._sources.values()}.get(role)
+            if victim is not None and len(victim.windows) >= 4:
+                break
+            time.sleep(0.1)
+        p.kill()            # SIGKILL: no flush, no final window
+        p.wait()
+        time.sleep(0.3)
+        fr_rows = 0
+        try:
+            with open(mpath) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(row, dict) and "counters" in row:
+                        fr_rows += 1
+        except OSError:
+            pass
+        hub_rows = len(victim.windows) if victim is not None else 0
+        acked = victim.acked if victim is not None else 0
+        timeline_rows = sum(
+            1 for rec_ in flight.read_chain(timeline)
+            if rec_.get("src", "").endswith(f":{role}")
+            and rec_.get("kind") == "window")
+        # every acked window is durable; the loss vs the on-disk
+        # recorder is bounded by the in-flight batch + the row being
+        # written at kill time
+        kill_ok = (hub_rows >= max(0, fr_rows - 2)
+                   and timeline_rows >= hub_rows > 0)
+        result["phase3"] = {
+            "victim_role": role, "fr_rows_on_disk": fr_rows,
+            "hub_windows": hub_rows, "acked_rows": acked,
+            "timeline_window_rows": timeline_rows,
+            "loss_bound_rows": 2, "kill_bound_met": kill_ok,
+        }
+        print(f"bench --fleet: SIGKILL bound "
+              f"{'OK' if kill_ok else 'FAILED'} (disk {fr_rows} vs "
+              f"hub {hub_rows} windows)", file=sys.stderr)
+
+    hub.stop()
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    # the throughput bar gates only the FULL run (best-of-3, the
+    # BENCH_OBS co-tenant-noise rule): a --quick single window on
+    # this shared box swings well past 5% and would flake tier-1 —
+    # the quick smoke gates the correctness contracts (exactness,
+    # process count, top frame) and records the ratio honestly
+    ok = ((result["phase1"]["bar_met"] or quick) and exact_ok
+          and sum_ok
+          and result.get("phase3", {}).get("kill_bound_met", True))
+    result["ok"] = ok
+    name = "BENCH_FLEET.quick.json" if quick else "BENCH_FLEET.json"
+    path = os.path.join(repo, name)
+    with open(path, "w") as f:
+        json.dump({**result, "captured_unix": time.time()}, f, indent=1)
+    print(f"bench: fleet-telemetry evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps({"metric": "fleet_telemetry_ok", "value": ok,
+                      "shipped_over_unshipped":
+                          result["phase1"]["shipped_over_unshipped"],
+                      "quick": quick}))
+    if not ok:
+        sys.exit(1)
+
+
 def serve_main() -> None:
     """`bench.py --serve`: the tuning-as-a-service load-generator
     bench (docs/SERVING.md) — one SessionServer process multiplexing
@@ -1939,6 +2317,12 @@ def main() -> None:
         return
     if "--multi" in sys.argv:
         multi_main()
+        return
+    if "--fleet-child" in sys.argv:
+        fleet_child_main()
+        return
+    if "--fleet" in sys.argv:
+        fleet_main()
         return
     if "--serve" in sys.argv:
         serve_main()

@@ -34,11 +34,15 @@ fi
 # a silenced hazard there would tax or skew the very measurements it
 # exists to make; the ISSUE 10 distributed-obs modules — sidecar,
 # flight, merge, top — the ISSUE 12 search-quality modules —
-# journal, quality, report — and the ISSUE 13 device-telemetry
+# journal, quality, report — the ISSUE 13 device-telemetry
 # module — device.py, which wraps EVERY engine/driver device program
-# — are part of the obs/ package and inherit the rule), and the
-# multi-tenant serving plane (ISSUE 8 — a silenced
-# retrace or host-sync hazard there stalls EVERY tenant at once) get
+# — and the ISSUE 14 fleet-telemetry modules — ship.py, whose
+# offer() sits on the driver/serve hot paths, and hub.py, the
+# collector every process reports into — are part of the obs/
+# package and inherit the rule), and the multi-tenant serving plane
+# (ISSUE 8 — a silenced retrace or host-sync hazard there stalls
+# EVERY tenant at once; since ISSUE 14 serve/wire.py is the service
+# kernel EVERY wire-speaking plane runs on) get
 # no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys

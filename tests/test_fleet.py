@@ -1,0 +1,724 @@
+"""Fleet telemetry (ISSUE 14, docs/OBSERVABILITY.md "Fleet
+telemetry"): the serve/wire.py service kernel the session server was
+rebased onto, the TelemetryShipper's bounded never-blocking queue +
+reconnect/backoff, the hub's rollup semantics (counter-sum exactness,
+gauge last-writes, labeled-approximate percentiles), ack-before-reply
+timeline durability under K concurrent shippers with kill -9-style
+disconnects, timeline rotation + restart replay, the `ut top`
+multi-metrics/--fleet satellites, the flight-recorder rotate-depth
+satellite, the serve health `limit=` satellite, and `ut report`'s
+multi-source rendering.
+
+Budget note: everything here is socket/thread-level and sub-second —
+no engine, no compiles; the real multi-process fleet e2e lives in
+`bench.py --fleet` (its --quick smoke is the tier-1 subprocess
+check)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import uptune_tpu
+from uptune_tpu import obs
+from uptune_tpu.obs import flight, ship, top
+from uptune_tpu.obs import hub as hub_mod
+from uptune_tpu.obs.hub import TelemetryHub, fleet_rollup
+from uptune_tpu.serve.wire import RequestError, WireServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    uptune_tpu.__file__)))
+
+
+@pytest.fixture(autouse=True)
+def obs_clean():
+    obs.reset()
+    yield
+    ship.stop()
+    obs.reset()
+
+
+def _wire_request(port, payload, keep=False):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    f = s.makefile("rwb")
+    f.write(json.dumps(payload).encode() + b"\n")
+    f.flush()
+    resp = json.loads(f.readline())
+    if keep:
+        return resp, (s, f)
+    f.close()
+    s.close()
+    return resp
+
+
+# ------------------------------------------------------- wire kernel
+class _EchoServer(WireServer):
+    WIRE_NAME = "ut-test-echo"
+
+    def __init__(self):
+        super().__init__("127.0.0.1", 0)
+        self.reaped = []
+
+    def _op_echo(self, req):
+        return {"echo": req.get("value")}
+
+    def _op_boom(self, req):
+        raise RuntimeError("kaboom")
+
+    def _op_bad(self, req):
+        raise RequestError("bad field")
+
+    _OPS = {"echo": _op_echo, "boom": _op_boom, "bad": _op_bad}
+
+    def _conn_opened(self, conn, addr):
+        return {"seen": 0}
+
+    def _on_response(self, state, req, resp):
+        state["seen"] += 1
+
+    def _conn_closed(self, state):
+        self.reaped.append(state["seen"])
+
+
+class TestWireKernel:
+    def test_dispatch_and_error_walls(self):
+        srv = _EchoServer()
+        out = srv.handle({"op": "echo", "value": 7, "id": "x"})
+        assert out == {"ok": True, "echo": 7, "id": "x"}
+        out = srv.handle({"op": "bad"})
+        assert not out["ok"] and out["error"] == "bad field"
+        out = srv.handle({"op": "boom"})
+        assert not out["ok"] and "internal" in out["error"]
+        out = srv.handle({"op": "nope"})
+        assert not out["ok"] and "unknown op" in out["error"]
+        out = srv.handle({"op": ["not", "hashable"]})
+        assert not out["ok"] and "unknown op" in out["error"]
+        assert not srv.handle(["not a dict"])["ok"]
+
+    def test_tcp_loop_and_conn_reaping_hooks(self):
+        with _EchoServer() as srv:
+            resp, (s, f) = _wire_request(srv.port,
+                                         {"op": "echo", "value": 1},
+                                         keep=True)
+            assert resp["ok"] and resp["echo"] == 1
+            # a bad-JSON line is answered, not fatal, and never
+            # reaches the response hook
+            f.write(b"this is not json\n")
+            f.flush()
+            assert not json.loads(f.readline())["ok"]
+            f.write(json.dumps({"op": "echo", "value": 2}).encode()
+                    + b"\n")
+            f.flush()
+            assert json.loads(f.readline())["echo"] == 2
+            f.close()       # makefile holds its own socket ref
+            s.close()
+            deadline = time.time() + 5
+            while not srv.reaped and time.time() < deadline:
+                time.sleep(0.01)
+        assert srv.reaped == [2]    # 2 parsed requests, 1 bad line
+
+    def test_session_server_is_a_wire_server(self):
+        from uptune_tpu.serve.server import SessionServer
+        assert issubclass(SessionServer, WireServer)
+        srv = SessionServer(port=0)     # not started: no sockets
+        assert srv.handle({"op": "ping"})["ok"]
+        assert srv.WIRE_NAME == "ut-serve"
+
+
+# ------------------------------------------------- shipper mechanics
+class TestShipper:
+    def test_bounded_queue_drops_oldest_with_accounting(self):
+        obs.enable()
+        sh = ship.TelemetryShipper("127.0.0.1:1", role="t",
+                                   queue_max=4)
+        for i in range(10):
+            assert sh.offer("journal", {"i": i})
+        assert sh.dropped == 6
+        with sh._qlock:
+            kept = [item["row"]["i"] for item in sh._q]
+        assert kept == [6, 7, 8, 9]     # oldest shed, newest kept
+        from uptune_tpu.obs import metrics as metrics_mod
+        assert metrics_mod.counter_value("ship.dropped") == 6
+
+    def test_offer_refused_after_stop(self):
+        sh = ship.TelemetryShipper("127.0.0.1:1", role="t")
+        sh._stop.set()
+        assert not sh.offer("journal", {})
+
+    def test_reconnect_with_backoff_flaky_listener(self, tmp_path):
+        """Hook-gated flaky hub: refuses the first 2 connections
+        (hello never answered), then behaves.  The shipper must
+        retry with backoff and deliver everything it queued —
+        nothing acked is lost, and the early failures are counted."""
+        refusals = {"left": 2}
+        gate_lock = threading.Lock()
+
+        class FlakyHub(TelemetryHub):
+            def _op_hello(self, req):
+                with gate_lock:
+                    if refusals["left"] > 0:
+                        refusals["left"] -= 1
+                        raise RequestError("not yet")
+                return TelemetryHub._op_hello(self, req)
+
+            # the dispatch table binds functions, not names — a
+            # subclass overriding an op must re-map it
+            _OPS = {**TelemetryHub._OPS, "hello": _op_hello}
+
+        with FlakyHub(port=0, timeline=str(tmp_path / "tl.jsonl")) \
+                as hub:
+            obs.enable()
+            sh = ship.TelemetryShipper(
+                f"127.0.0.1:{hub.port}", role="flaky-test",
+                interval=0.05, backoff_base=0.02, backoff_max=0.1)
+            sh.start()
+            obs.count("test.counter", 5)
+            deadline = time.time() + 10
+            while sh.acked == 0 and time.time() < deadline:
+                time.sleep(0.02)
+            sh.stop()
+            assert refusals["left"] == 0
+            assert sh.failures >= 2         # the gated refusals
+            assert sh.connects >= 1
+            assert sh.acked > 0
+            src = next(iter(hub._sources.values()))
+            assert src.last_window["counters"]["test.counter"] == 5
+
+    def test_exactness_contract_vs_flight_recorder(self, tmp_path):
+        """The unit half of BENCH_FLEET's exactness contract: after a
+        clean stop, the hub's last window for a source equals the
+        source's own final flight-recorder row, counter for
+        counter."""
+        mpath = str(tmp_path / "m.jsonl")
+        with TelemetryHub(port=0,
+                          timeline=str(tmp_path / "tl.jsonl")) as hub:
+            obs.enable()
+            rec = flight.start(mpath, interval=0.05)
+            sh = ship.TelemetryShipper(f"127.0.0.1:{hub.port}",
+                                       role="exact", interval=0.05)
+            sh.start()
+            for i in range(137):
+                obs.count("driver.asks")
+                if i % 3 == 0:
+                    obs.observe("serve.ask_ms", 0.1 * i)
+            obs.gauge("pool.busy", 2)
+            time.sleep(0.15)
+            sh.stop()
+            rec.stop()
+            src = next(iter(hub._sources.values()))
+            hub_counters = src.last_window["counters"]
+            final = [json.loads(line)
+                     for line in open(mpath)][-1]
+            assert final.get("final") is True
+            assert hub_counters == final["counters"]
+            assert src.last_window["gauges"] == final["gauges"]
+            assert src.final_seen
+
+    def test_final_window_cut_when_stop_lands_in_backoff(self):
+        """stop() arriving while the loop sits in its reconnect
+        backoff must still cut a final=true terminal window (it ends
+        up queued for the unreachable hub, but a hub that came back
+        during the last drain would receive it)."""
+        obs.enable()
+        sh = ship.TelemetryShipper("127.0.0.1:1", role="t",
+                                   interval=0.02, backoff_base=5.0,
+                                   connect_timeout=0.2)
+        sh.start()
+        deadline = time.time() + 10
+        while sh.failures == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        sh.stop(timeout=10)     # lands inside the 5 s backoff wait
+        with sh._qlock:
+            items = list(sh._q) + (sh._pending or [])
+        finals = [i for i in items
+                  if i["kind"] == "window" and i["row"].get("final")]
+        assert finals, "terminal window lost its final flag"
+
+    def test_env_wiring_role_suffix(self, tmp_path):
+        with TelemetryHub(port=0, timeline=None) as hub:
+            env = {"UT_TELEMETRY": f"127.0.0.1:{hub.port}",
+                   "UT_PROCESS_ID": "3"}
+            sh = ship.maybe_ship_from_env(role="ut-driver", env=env)
+            assert sh is not None
+            assert sh.source["role"] == "ut-driver.h3"
+            sh.stop()
+            assert ship.maybe_ship_from_env(env={}) is None
+            assert ship.maybe_ship_from_env(
+                env={"UT_TELEMETRY": "off"}) is None
+
+
+# ------------------------------------------------------- hub rollup
+class TestFleetRollup:
+    def test_counter_sums_exact_gauges_last_write(self):
+        rows = [
+            ("a", {"t": 10.0, "dt": 1.0,
+                   "counters": {"x": 5, "y": 1.5},
+                   "deltas": {"x": 2}, "gauges": {"g": 1}, "hists": {}}),
+            ("b", {"t": 11.0, "dt": 0.5,
+                   "counters": {"x": 7},
+                   "deltas": {"x": 3}, "gauges": {"g": 9}, "hists": {}}),
+        ]
+        roll = fleet_rollup(rows)
+        assert roll["counters"] == {"x": 12, "y": 1.5}
+        assert roll["deltas"] == {"x": 5}
+        assert roll["gauges"]["g"] == 9     # newest t wins
+        assert roll["dt"] == 1.0
+        assert roll["per_source"] == ["a", "b"]
+
+    def test_hist_percentiles_weighted_and_labeled_approx(self):
+        rows = [
+            ("a", {"t": 1, "dt": 1, "counters": {}, "deltas": {},
+                   "gauges": {},
+                   "hists": {"h": {"count": 10, "sum": 10.0,
+                                   "window_count": 10,
+                                   "window_sum": 10.0,
+                                   "p50": 1.0, "p95": 2.0}}}),
+            ("b", {"t": 1, "dt": 1, "counters": {}, "deltas": {},
+                   "gauges": {},
+                   "hists": {"h": {"count": 30, "sum": 90.0,
+                                   "window_count": 30,
+                                   "window_sum": 90.0,
+                                   "p50": 3.0, "p95": 4.0}}}),
+        ]
+        h = fleet_rollup(rows)["hists"]["h"]
+        assert h["count"] == 40 and h["sum"] == 100.0
+        assert h["window_count"] == 40
+        assert h["p50"] == pytest.approx(2.5)   # (10*1 + 30*3) / 40
+        assert h["p95"] == pytest.approx(3.5)
+        assert h["approx"] is True
+
+
+def _ship_req(role, rows, host="hx", pid=1):
+    return {"op": "ship",
+            "source": {"host": host, "pid": pid, "role": role},
+            "rows": rows}
+
+
+def _win(t, counters, final=False, **kw):
+    row = {"t": t, "dt": 1.0, "counters": counters, "deltas": {},
+           "gauges": {}, "hists": {}, **kw}
+    if final:
+        row["final"] = True
+    return {"kind": "window", "row": row}
+
+
+class TestHubOps:
+    def test_ship_metrics_sources_roundtrip(self, tmp_path):
+        hub = TelemetryHub(port=0, timeline=str(tmp_path / "t.jsonl"))
+        assert hub.handle(_ship_req("r1", [
+            _win(1.0, {"driver.asks": 10}),
+            {"kind": "journal", "row": {"ev": "step", "t": 0.1}},
+        ]))["acked"] == 2
+        assert hub.handle(_ship_req("r2", [
+            _win(2.0, {"driver.asks": 32}), ], pid=2))["acked"] == 1
+        m = hub.handle({"op": "metrics"})
+        assert m["sources"] == 2
+        assert m["metrics"]["counters"]["driver.asks"] == 42
+        rows = hub.handle({"op": "sources"})["rows"]
+        assert [r["role"] for r in rows] == ["r1", "r2"]
+        r1 = next(r for r in rows if r["role"] == "r1")
+        assert r1["journal_rows"] == 1 and r1["windows"] == 1
+        hub.stop()
+
+    def test_timeline_durable_before_ack(self, tmp_path):
+        """Ack-implies-durable: when handle() returns ok, the rows are
+        already flushed to the fleet timeline."""
+        tl = str(tmp_path / "t.jsonl")
+        hub = TelemetryHub(port=0, timeline=tl)
+        hub.handle(_ship_req("r1", [_win(1.0, {"c": 1})]))
+        lines = [json.loads(x) for x in open(tl)]
+        assert lines[0]["fleet"] == 1       # header
+        assert lines[1]["src"] == "hx:1:r1"
+        assert lines[1]["row"]["counters"] == {"c": 1}
+        hub.stop()
+
+    def test_health_worst_first_stale_and_limit(self, tmp_path):
+        hub = TelemetryHub(port=0, timeline=None, stale_s=0.5)
+        hub.handle(_ship_req("quiet", [_win(1.0, {})]))
+        hub.handle(_ship_req("healthy", [_win(1.0, {})], pid=2))
+        hub.handle(_ship_req("sick", [
+            {"kind": "health",
+             "row": {"t": 1.0, "sessions": 3,
+                     "by_status": {"failing": 1, "ok": 2}}}], pid=3))
+        # age the quiet source past the staleness bar
+        hub._sources[("hx", "1", "quiet")].last_unix -= 10
+        out = hub.handle({"op": "health"})
+        assert out["ok"]
+        statuses = [r["status"] for r in out["health"]]
+        assert statuses[0] == "failing"     # worst first
+        assert "stale" in statuses
+        assert out["by_status"]["failing"] == 1
+        # bounded payload: limit= honored and validated
+        out = hub.handle({"op": "health", "limit": 1})
+        assert len(out["health"]) == 1 and out["truncated"]
+        assert out["health"][0]["status"] == "failing"
+        assert not hub.handle({"op": "health", "limit": 0})["ok"]
+        assert not hub.handle({"op": "health", "limit": 99999})["ok"]
+        assert not hub.handle({"op": "health", "limit": "x"})["ok"]
+        hub.stop()
+
+    def test_health_poll_races_active_shippers(self):
+        """A health poll must never leak an internal error while ship
+        batches mutate per-source state (the alerts deque) — rows are
+        built under the hub lock."""
+        hub = TelemetryHub(port=0, timeline=None)
+        stop = threading.Event()
+
+        def pound():
+            i = 0
+            while not stop.is_set():
+                hub.handle(_ship_req("noisy", [
+                    {"kind": "alert", "row": {"kind": "stall",
+                                              "t": float(i)}}]))
+                i += 1
+
+        t = threading.Thread(target=pound)
+        t.start()
+        try:
+            for _ in range(300):
+                out = hub.handle({"op": "health"})
+                assert out["ok"], out
+        finally:
+            stop.set()
+            t.join(5)
+        assert out["health"][0]["status"] == "stalled"
+        hub.stop()
+
+    def test_timeline_rotation_and_restart_replay(self, tmp_path):
+        tl = str(tmp_path / "t.jsonl")
+        hub = TelemetryHub(port=0, timeline=tl, timeline_rows=3,
+                           timeline_rotate=2)
+        for i in range(8):
+            hub.handle(_ship_req("r1", [_win(float(i),
+                                             {"c": i + 1})]))
+        hub.stop()
+        assert hub.timeline_rotations >= 2
+        assert os.path.exists(tl + ".1") and os.path.exists(tl + ".2")
+        assert not os.path.exists(tl + ".3")    # depth respected
+        # chain reads oldest-first across generations
+        rows = [r for r in flight.read_chain(tl) if "src" in r]
+        assert [r["row"]["counters"]["c"] for r in rows] == \
+            list(range(1, 9))
+        # a restarted hub replays the chain and serves the old view
+        hub2 = TelemetryHub(port=0, timeline=tl, timeline_rows=100)
+        m = hub2.handle({"op": "metrics"})
+        assert m["sources"] == 1
+        assert m["metrics"]["counters"]["c"] == 8   # last window
+        src = next(iter(hub2._sources.values()))
+        assert src.meta.get("replayed")
+        hub2.stop()
+
+
+# ------------------------------------- concurrency + kill durability
+class TestHubConcurrency:
+    def test_k_shippers_with_kill9_disconnects_lose_nothing_acked(
+            self, tmp_path):
+        """K concurrent wire writers, half of which abort their
+        socket mid-stream without any goodbye (the kill -9 shape):
+        every batch that was ACKED must be present in the fleet
+        timeline; un-acked in-flight batches are the only loss."""
+        tl = str(tmp_path / "t.jsonl")
+        acked = [0] * 6
+        with TelemetryHub(port=0, timeline=tl) as hub:
+            def run(k):
+                s = socket.create_connection(
+                    ("127.0.0.1", hub.port), timeout=10)
+                f = s.makefile("rwb")
+                for b in range(10):
+                    req = _ship_req(f"w{k}", [
+                        _win(float(b), {"n": b + 1})], pid=100 + k)
+                    f.write(json.dumps(req).encode() + b"\n")
+                    f.flush()
+                    resp = json.loads(f.readline())
+                    assert resp["ok"]
+                    acked[k] += 1
+                    if k % 2 == 0 and b == 4:
+                        # kill -9 shape: abort, no close handshake
+                        s.close()
+                        return
+                f.close()
+                s.close()
+
+            threads = [threading.Thread(target=run, args=(k,))
+                       for k in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+        rows = [json.loads(x) for x in open(tl)][1:]
+        by_src = {}
+        for r in rows:
+            by_src[r["src"]] = by_src.get(r["src"], 0) + 1
+        for k in range(6):
+            assert by_src.get(f"hx:{100 + k}:w{k}", 0) == acked[k]
+        assert sum(acked) == sum(by_src.values())
+
+    def test_threaded_shippers_concurrent_rollup_consistent(self):
+        """K real TelemetryShippers against one hub: the fleet
+        counter rollup equals the sum of each source's final
+        registry... but shippers in one process share ONE metrics
+        registry, so this asserts the rollup = per-source last
+        windows sum (structural) and that every shipper's final
+        window arrived."""
+        with TelemetryHub(port=0, timeline=None) as hub:
+            obs.enable()
+            obs.count("shared.counter", 10)
+            shippers = [ship.TelemetryShipper(
+                f"127.0.0.1:{hub.port}", role=f"s{k}", interval=0.05)
+                for k in range(4)]
+            for sh in shippers:
+                sh.start()
+            time.sleep(0.2)
+            for sh in shippers:
+                sh.stop()
+            assert len(hub._sources) == 4
+            for src in hub._sources.values():
+                assert src.final_seen
+                assert src.last_window["counters"][
+                    "shared.counter"] == 10
+            m = hub.handle({"op": "metrics"})["metrics"]
+            assert m["counters"]["shared.counter"] == 40
+
+
+# ------------------------------------------- flight rotate satellite
+class TestFlightRotateDepth:
+    def test_rotate_files_shifts_chain(self, tmp_path):
+        p = str(tmp_path / "f.jsonl")
+        for gen, text in ((2, "old"), (1, "mid")):
+            with open(f"{p}.{gen}", "w") as f:
+                f.write(text + "\n")
+        with open(p, "w") as f:
+            f.write("new\n")
+        flight.rotate_files(p, 3)
+        assert open(f"{p}.3").read().strip() == "old"
+        assert open(f"{p}.2").read().strip() == "mid"
+        assert open(f"{p}.1").read().strip() == "new"
+        assert not os.path.exists(p)
+        # depth 1 = historical behavior: .1 only
+        with open(p, "w") as f:
+            f.write("newer\n")
+        flight.rotate_files(p, 1)
+        assert open(f"{p}.1").read().strip() == "newer"
+
+    def test_recorder_honors_rotate_depth(self, tmp_path):
+        obs.enable()
+        p = str(tmp_path / "m.jsonl")
+        rec = flight.FlightRecorder(p, interval=60, max_rows=2,
+                                    rotate=3)
+        rec.start()
+        for _ in range(7):
+            rec._write_row()
+        rec.stop()
+        chain = flight.chain(p)
+        assert chain[-1] == p and len(chain) >= 3
+        rows = flight.read_chain(p)
+        # rows survive across generations in write order
+        pids = [r["pid"] for r in rows if "pid" in r]
+        assert len(pids) == len(rows) and len(rows) >= 6
+
+    def test_top_last_rows_crosses_rotation_boundary(self, tmp_path):
+        p = str(tmp_path / "m.jsonl")
+        with open(p + ".1", "w") as f:
+            f.write(json.dumps({"t": 1.0, "counters": {"a": 1}}) + "\n")
+            f.write(json.dumps({"t": 2.0, "counters": {"a": 2}}) + "\n")
+        with open(p, "w") as f:
+            f.write(json.dumps({"t": 3.0, "counters": {"a": 3}}) + "\n")
+        rows = top.last_rows(p, 3)
+        assert [r["counters"]["a"] for r in rows] == [1, 2, 3]
+
+
+# --------------------------------------------------- top satellites
+class TestTopFleet:
+    def _write_metrics(self, path, asks, t=None, gauges=None):
+        with open(path, "w") as f:
+            f.write(json.dumps({
+                "t": t or time.time(), "dt": 1.0,
+                "counters": {"driver.asks": asks},
+                "deltas": {"driver.asks": asks},
+                "gauges": gauges or {}, "hists": {}}) + "\n")
+
+    def test_multi_metrics_glob_fleet_rolled_frame(self, tmp_path,
+                                                   capsys):
+        self._write_metrics(str(tmp_path / "m.jsonl"), 100)
+        self._write_metrics(str(tmp_path / "m.h1.jsonl"), 50)
+        rc = top.main(["--metrics", str(tmp_path / "m*.jsonl"),
+                       "--once", "--json", "--fleet"])
+        assert rc == 0
+        frame = json.loads(capsys.readouterr().out)
+        assert frame["counters"]["driver.asks"] == 150
+        assert frame["meta"]["sources"] == 2
+        labels = {s["source"] for s in frame["sources"]}
+        assert labels == {"m.jsonl", "m.h1.jsonl"}
+
+    def test_single_metrics_path_unchanged(self, tmp_path, capsys):
+        p = str(tmp_path / "m.jsonl")
+        self._write_metrics(p, 7)
+        rc = top.main(["--metrics", p, "--once", "--json"])
+        assert rc == 0
+        frame = json.loads(capsys.readouterr().out)
+        assert frame["counters"]["driver.asks"] == 7
+        assert "sources" not in frame
+
+    def test_top_addr_hub_renders_fleet(self, capsys):
+        with TelemetryHub(port=0, timeline=None) as hub:
+            hub.handle(_ship_req("r1", [_win(
+                1.0, {"serve.asks": 42},
+                gauges={"serve.sessions.active": 2})]))
+            rc = top.main(["--addr", f"127.0.0.1:{hub.port}",
+                           "--once", "--fleet"])
+            out = capsys.readouterr().out
+        assert rc == 0
+        assert "sources   (1)" in out
+        assert "hx:1:r1" in out
+
+    def test_render_fleet_lines_stale_first(self):
+        lines = top.fleet_lines([
+            {"source": "b", "age_s": 0.1, "rates": {}, "stale": False},
+            {"source": "a", "age_s": 60.0, "rates": {}, "stale": True},
+        ])
+        assert "(2)" in lines[0]
+        assert "a" in lines[1] and "STALE" in lines[1]
+
+
+# ------------------------------------------- serve health limit (sat)
+class _FakeSession:
+    def __init__(self, sid, status):
+        self.id = sid
+        self._status = status
+
+    def health(self, **kw):
+        return {"session": self.id, "status": self._status}
+
+
+class TestServeHealthLimit:
+    def _server(self):
+        from uptune_tpu.serve.server import SessionServer
+        return SessionServer(port=0)    # not started: no sockets
+
+    def test_limit_bounds_and_default(self):
+        srv = self._server()
+        srv._sessions = {f"s{i}": _FakeSession(f"s{i}", "ok")
+                         for i in range(70)}
+        out = srv.handle({"op": "health"})
+        assert out["ok"] and len(out["health"]) == 64
+        assert out["truncated"] and out["sessions"] == 70
+        out = srv.handle({"op": "health", "limit": 70})
+        assert len(out["health"]) == 70 and not out["truncated"]
+        out = srv.handle({"op": "health", "limit": 2})
+        assert len(out["health"]) == 2 and out["truncated"]
+        for bad in (0, -3, 4096, "x"):
+            assert not srv.handle({"op": "health",
+                                   "limit": bad})["ok"]
+
+    def test_worst_first_survives_truncation(self):
+        srv = self._server()
+        srv._sessions = {"a": _FakeSession("a", "ok"),
+                         "b": _FakeSession("b", "failing"),
+                         "c": _FakeSession("c", "stalled")}
+        out = srv.handle({"op": "health", "limit": 2})
+        assert [r["status"] for r in out["health"]] == \
+            ["failing", "stalled"]
+
+
+# ------------------------------------------------ report multi-source
+def _write_journal(path, qors, arm="de"):
+    from uptune_tpu.obs import journal
+    with open(path, "w") as f:
+        f.write(json.dumps({"journal": journal.SCHEMA_VERSION,
+                            "origin_unix": 1.0, "pid": 1,
+                            "meta": {}}) + "\n")
+        best = None
+        for i, q in enumerate(qors):
+            nb = best is None or q < best
+            best = q if nb else best
+            f.write(json.dumps({
+                "ev": "step", "t": 0.1 * i, "arm": arm, "src": "arm",
+                "batch": 1, "trials": 1, "dup": 0, "qors": [q],
+                "nb": [nb], "gid0": i, "best": best}) + "\n")
+
+
+class TestReportMultiSource:
+    def test_multiple_journals_render_per_source(self, tmp_path,
+                                                 capsys):
+        from uptune_tpu.obs import report
+        j1 = str(tmp_path / "a.h0.jsonl")
+        j2 = str(tmp_path / "a.h1.jsonl")
+        _write_journal(j1, [5.0, 3.0, 4.0])
+        _write_journal(j2, [9.0, 2.0], arm="pso")
+        rc = report.main([str(tmp_path / "a.h*.jsonl"),
+                          "--format", "md", "-o", "-"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "## Sources" in out
+        assert "## Source: a.h0.jsonl" in out
+        assert "## Source: a.h1.jsonl" in out
+        assert "pso" in out and "de" in out
+
+    def test_fleet_timeline_detected_and_split(self, tmp_path,
+                                               capsys):
+        from uptune_tpu.obs import report
+        tl = str(tmp_path / "ut.fleet.jsonl")
+        hub = TelemetryHub(port=0, timeline=tl)
+        hub.handle(_ship_req("driver.h0", [
+            {"kind": "journal",
+             "row": {"ev": "step", "t": 0.1, "arm": "de",
+                     "qors": [1.0], "nb": [True], "gid0": 0,
+                     "best": 1.0}},
+            _win(1.0, {"driver.asks": 4}),
+        ]))
+        hub.handle(_ship_req("driver.h1", [
+            {"kind": "journal",
+             "row": {"ev": "step", "t": 0.2, "arm": "pso",
+                     "qors": [2.0], "nb": [True], "gid0": 0,
+                     "best": 2.0}}], pid=2))
+        hub.stop()
+        sources = report.read_sources([tl])
+        assert [s[0] for s in sources] == ["hx:1:driver.h0",
+                                           "hx:2:driver.h1"]
+        rc = report.main([tl, "--format", "md", "-o", "-"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "hx:1:driver.h0" in out and "hx:2:driver.h1" in out
+        # html renders self-contained too
+        html = report.render_multi(sources, fmt="html")
+        assert "ut report — fleet" in html and "driver.h0" in html
+
+    def test_single_journal_unchanged(self, tmp_path, capsys):
+        from uptune_tpu.obs import report
+        j = str(tmp_path / "j.jsonl")
+        _write_journal(j, [5.0, 3.0])
+        rc = report.main([j, "--format", "md", "-o", "-"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert out.startswith("# ut report")
+        assert "## Sources" not in out
+
+
+# --------------------------------------------------- bench smoke
+class TestFleetBenchSmoke:
+    def test_bench_fleet_quick(self, tmp_path):
+        """The tier-1 fleet e2e: 4 real processes (2 driver replicas,
+        1 `ut serve`, the bench client) shipping to one hub, the
+        exactness contract and the >= 0.95x shipper bar asserted by
+        the bench itself (rc != 0 on any failure)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+                   UT_TRACE="", UT_JOURNAL="", UT_TELEMETRY="")
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--fleet", "--quick"],
+            cwd=str(tmp_path), env=env, capture_output=True,
+            text=True, timeout=560)
+        assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-3000:]
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("{")][-1]
+        out = json.loads(line)
+        assert out["value"] is True
+        art = json.load(open(os.path.join(REPO,
+                                          "BENCH_FLEET.quick.json")))
+        assert art["phase2"]["all_sources_exact"]
+        assert art["phase2"]["fleet_counter_sum_exact"]
+        assert art["phase2"]["processes"] == 4

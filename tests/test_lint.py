@@ -634,14 +634,19 @@ def test_package_suppression_free(package):
     instrumentation living INSIDE every hot path (ISSUE 7; the
     ISSUE 10 distributed-obs modules — sidecar, flight recorder,
     merge, top — the ISSUE 12 search-quality modules — journal,
-    quality, report — and the ISSUE 13 device-telemetry module —
-    device.py, wrapping every engine/driver device program — live in
-    the same package and inherit the rule)
+    quality, report — the ISSUE 13 device-telemetry module —
+    device.py, wrapping every engine/driver device program — and the
+    ISSUE 14 fleet-telemetry modules — ship.py, whose offer() sits on
+    the driver/serve hot paths, and hub.py, the collector every
+    process reports into — live in the same package and inherit the
+    rule)
     — a silenced hazard there would tax or skew the measurements it
     exists to make; serve/ multiplexes every tenant onto three shared
     compiled programs (ISSUE 8) — a silenced retrace or host-sync
-    hazard there stalls ALL sessions at once.  lint.sh enforces the
-    same in the pre-commit gate."""
+    hazard there stalls ALL sessions at once, and since ISSUE 14 its
+    wire.py service kernel carries EVERY wire-speaking plane (session
+    server + telemetry hub).  lint.sh enforces the same in the
+    pre-commit gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",
          os.path.join(REPO, "uptune_tpu", package),

@@ -69,6 +69,13 @@ DEFAULTS: Dict[str, Any] = {
     # `ut --journal` flag and the UT_JOURNAL env var; None/'off'
     # leaves it disabled (one flag check per call site)
     "journal": None,
+    # fleet telemetry (docs/OBSERVABILITY.md "Fleet telemetry"):
+    # 'host:port' of a running `ut hub` collector — the process ships
+    # metrics window snapshots, journal rows, alerts and health
+    # rollups there over a bounded never-blocking queue.  Layered
+    # under the `--telemetry` flags and the UT_TELEMETRY env var
+    # (which --num-hosts replicas inherit); None/'off' disables
+    "telemetry": None,
     # async surrogate plane (docs/PERF.md): 'on' (None = default) moves
     # the O(N^3) GP refit + fit_auto hyperparameter sweep onto a
     # background worker publishing versioned snapshots, so the driver

@@ -208,6 +208,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "the background thread and restores the "
                         "single end-of-run metrics snapshot).  Only "
                         "meaningful with --trace/UT_TRACE")
+    p.add_argument("--metrics-rotate", type=int, default=None,
+                   metavar="N",
+                   help="flight-recorder rotation depth: generations "
+                        "kept past the row cap (<file>.1 … <file>.N; "
+                        "default 1).  `ut top --metrics` and the "
+                        "fleet hub read through the whole chain")
+    p.add_argument("--telemetry", default=None, metavar="HOST:PORT",
+                   help="fleet telemetry (docs/OBSERVABILITY.md "
+                        "'Fleet telemetry'): ship this process's "
+                        "metrics window snapshots, journal rows, "
+                        "alerts and health rollups to a running "
+                        "`ut hub` collector over a bounded "
+                        "never-blocking queue with reconnect/backoff "
+                        "and explicit drop accounting.  --num-hosts "
+                        "replicas each ship under their own "
+                        "(host, pid, role.hN) source key.  Also "
+                        "reachable via UT_TELEMETRY or "
+                        "ut.config({'telemetry': ...}); 'off' "
+                        "disables")
     p.add_argument("--device-trace", default=None, metavar="DIR",
                    help="programmatic jax.profiler capture for the "
                         "whole run (docs/OBSERVABILITY.md 'Device "
@@ -377,9 +396,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # telemetry")
         from .obs.report import main as report_main
         return report_main(raw[1:])
+    if raw and raw[0] == "hub":
+        # `ut hub ...`: the fleet-telemetry collector every
+        # --telemetry process ships to (docs/OBSERVABILITY.md
+        # "Fleet telemetry")
+        from .obs.hub import main as hub_main
+        return hub_main(raw[1:])
     first_pos = next((a for a in raw if not a.startswith("-")), None) \
         if raw and raw[0].startswith("-") else None
-    if first_pos in ("serve", "top", "report"):
+    if first_pos in ("serve", "top", "report", "hub"):
         # `ut -v serve` / `ut -v top` fall through and try to TUNE a
         # program file literally named like the subcommand.  A hint
         # only — never abort: the word may legitimately be a flag
@@ -573,7 +598,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         mi = (args.metrics_interval if args.metrics_interval is not None
               else 1.0)
         if mi > 0:
-            obs.start_flight_recorder(trace_path, interval=mi)
+            obs.start_flight_recorder(
+                trace_path, interval=mi,
+                rotate=(args.metrics_rotate
+                        if args.metrics_rotate is not None
+                        else obs.flight.DEFAULT_ROTATE))
+
+    # fleet telemetry (docs/OBSERVABILITY.md "Fleet telemetry"): flag
+    # > UT_TELEMETRY env > ut.config('telemetry').  Started BEFORE the
+    # tune so warm-start and every ticket's windows reach the hub;
+    # --num-hosts replicas inherit UT_TELEMETRY and suffix their role
+    shipper = None
+    telemetry = args.telemetry
+    if telemetry is None:
+        # an env value — INCLUDING 'off' — wins over ut.config, the
+        # same layering as serve/cli.py and the journal above
+        telemetry = os.environ.get("UT_TELEMETRY", "").strip() or None
+        if telemetry is None:
+            cfg_t = settings["telemetry"]
+            if not obs.ship.disabled_token(cfg_t):
+                telemetry = str(cfg_t)
+    if obs.ship.disabled_token(telemetry):
+        telemetry = None
+    if telemetry:
+        role = ("ut-driver" if not pid_env or pid_env == "0"
+                else f"ut-driver.h{pid_env}")
+        shipper = obs.ship.start(telemetry, role=role)
+        # telemetry without trace/journal must still hook
+        # SIGINT/SIGTERM: the exit flush's ship.stop() is what ships
+        # the final=true terminal window when a supervisor kills the
+        # run (idempotent when --trace already installed it)
+        obs.install_exit_flush(None)
 
     # device-plane profiler capture (ISSUE 13): flag > UT_DEVICE_TRACE
     # env; independent of --trace (the XPlane dump stands alone in
@@ -664,6 +719,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             log.info("[ut] %s", line)
     elif guard.enabled:
         log.info("[ut] trace-guard: %s", json.dumps(guard.report()))
+    if shipper is not None:
+        # final window + drain: the hub's last row for this source
+        # carries the run's terminal counters (the exactness contract
+        # BENCH_FLEET asserts against the flight-recorder finals)
+        shipper.stop()
+        st = shipper.stats()
+        log.info("[ut] telemetry shipped to %s:%s (%d rows acked, "
+                 "%d dropped)", shipper.addr[0], shipper.addr[1],
+                 st["acked"], st["dropped"])
     log.info("[ut] done: best qor=%.6g evals=%d", res.best_qor, res.evals)
     print(json.dumps({"best_config": res.best_config,
                       "best_qor": res.best_qor, "evals": res.evals}))

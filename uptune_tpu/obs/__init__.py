@@ -27,7 +27,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-from . import device, flight, journal, quality
+from . import device, flight, journal, quality, ship
 from .core import (DEFAULT_CAPACITY, complete_span, device_span,
                    disable, emit_at, enable, enabled, event,
                    new_span_id, now, reset, snapshot, span,
@@ -48,7 +48,7 @@ __all__ = [
     "maybe_enable_from_env", "finish", "start_flight_recorder",
     "install_exit_flush", "instrument_device_fn", "DEFAULT_CAPACITY",
     "journal", "quality", "start_journal", "stop_journal",
-    "maybe_journal_from_env", "device",
+    "maybe_journal_from_env", "device", "flight", "ship",
 ]
 
 
@@ -143,16 +143,20 @@ def finish(path: Optional[str],
 def start_flight_recorder(trace_path: str,
                           interval: float = flight.DEFAULT_INTERVAL,
                           metrics_path: Optional[str] = None,
-                          max_rows: int = flight.DEFAULT_MAX_ROWS
+                          max_rows: int = flight.DEFAULT_MAX_ROWS,
+                          rotate: int = flight.DEFAULT_ROTATE
                           ) -> "flight.FlightRecorder":
     """Start the periodic metrics timeline for a traced run, on the
     same `<trace>.metrics.jsonl` sidecar `finish()` settles (so the
     one-shot scrape becomes a timeline, not a second file).  `ut top
     --metrics <sidecar>` tails it live; `interval <= 0` is rejected by
-    the caller layer ('off')."""
+    the caller layer ('off').  `rotate` is the generation-chain depth
+    kept past the row cap (`--metrics-rotate`; default 1, the
+    historical single-`.1` behavior)."""
     return flight.start(metrics_path or trace_path + ".metrics.jsonl",
                         interval=interval, max_rows=max_rows,
-                        extra={"trace": os.path.basename(trace_path)})
+                        extra={"trace": os.path.basename(trace_path)},
+                        rotate=rotate)
 
 
 # ------------------------------------------------------- exit flushing
@@ -177,6 +181,11 @@ def _flush_all(reason: str) -> None:
         # the tuning journal's buffered tail rides the same graceful
         # flush: an interrupted run keeps its search telemetry too
         journal.flush()
+        # and the fleet shipper's final window: a SIGTERM'd process
+        # ships its terminal counters before the interpreter dies, so
+        # the hub's exactness contract (fleet counters == the sum of
+        # per-source finals) holds through graceful shutdowns
+        ship.stop()
         # an active jax.profiler capture must also settle, or the
         # XPlane dump is lost on exactly the failed/^C runs one most
         # wants to profile (stop_trace is idempotent-safe when no

@@ -11,12 +11,17 @@ per-window counter deltas and histogram-window percentiles, so rates
 without diffing absolute scrapes.  `ut top --metrics <file>` tails
 exactly this stream.
 
-Bounded by construction: at `max_rows` the file rotates to
-``<path>.1`` (one generation kept — same bounded-buffer philosophy as
-the span rings), so leaving the recorder on forever costs a fixed disk
-budget.  `stop()` writes one final row (marked ``"final": true``) and
-is idempotent — it is called from the normal `obs.finish()` path, the
-SIGINT/atexit flush (`obs.install_exit_flush`), or both.
+Bounded by construction: at `max_rows` the file rotates — the current
+generation moves to ``<path>.1`` (older generations shift to ``.2`` …
+``.N`` up to the configured `rotate` depth; default 1, the historical
+behavior) — so leaving the recorder on forever costs a fixed disk
+budget.  `chain(path)` lists the surviving generations oldest-first
+and `read_chain(path)` replays their rows in write order: `ut top`'s
+tail and the fleet hub's timeline replay both read through rotation
+boundaries instead of forgetting everything at each cap.  `stop()`
+writes one final row (marked ``"final": true``) and is idempotent —
+it is called from the normal `obs.finish()` path, the SIGINT/atexit
+flush (`obs.install_exit_flush`), or both.
 """
 from __future__ import annotations
 
@@ -24,15 +29,72 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from . import metrics
 
 __all__ = ["FlightRecorder", "start", "stop", "active_for",
-           "DEFAULT_INTERVAL", "DEFAULT_MAX_ROWS"]
+           "rotate_files", "chain", "read_chain",
+           "DEFAULT_INTERVAL", "DEFAULT_MAX_ROWS", "DEFAULT_ROTATE"]
 
 DEFAULT_INTERVAL = 1.0
 DEFAULT_MAX_ROWS = 20000
+DEFAULT_ROTATE = 1
+
+
+def rotate_files(path: str, depth: int) -> None:
+    """Shift the rotation chain one generation: ``.N-1`` -> ``.N`` …
+    ``<path>`` -> ``.1`` (the oldest generation past `depth` is
+    dropped).  Best-effort per link — a vanished generation never
+    breaks the shift.  Shared by the flight recorder and the fleet
+    hub's timeline (obs/hub.py), so every rotation-capped JSONL in
+    the obs plane ages the same way."""
+    depth = max(1, int(depth))
+    for i in range(depth, 1, -1):
+        try:
+            os.replace(f"{path}.{i - 1}", f"{path}.{i}")
+        except OSError:
+            pass
+    try:
+        os.replace(path, path + ".1")
+    except OSError:
+        pass
+
+
+def chain(path: str) -> List[str]:
+    """Existing generations of a rotation-capped JSONL, OLDEST first
+    (``.N`` … ``.1``, then the live file)."""
+    out: List[str] = []
+    n = 1
+    while os.path.exists(f"{path}.{n}"):
+        n += 1
+    for i in range(n - 1, 0, -1):
+        out.append(f"{path}.{i}")
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_chain(path: str) -> List[Dict[str, Any]]:
+    """Every parseable JSON row across the rotation chain, in write
+    order (torn lines skipped — same tolerance as every obs JSONL)."""
+    rows: List[Dict[str, Any]] = []
+    for p in chain(path):
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        row = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(row, dict):
+                        rows.append(row)
+        except OSError:
+            continue
+    return rows
 
 # path -> running recorder; obs.finish() consults this so a run with a
 # recorder gets its final row + close instead of a second (schema-
@@ -51,10 +113,12 @@ class FlightRecorder:
 
     def __init__(self, path: str, interval: float = DEFAULT_INTERVAL,
                  max_rows: int = DEFAULT_MAX_ROWS,
-                 extra: Optional[Dict[str, Any]] = None):
+                 extra: Optional[Dict[str, Any]] = None,
+                 rotate: int = DEFAULT_ROTATE):
         self.path = path
         self.interval = max(0.01, float(interval))
         self.max_rows = int(max_rows)
+        self.rotate = max(1, int(rotate))
         self.extra = dict(extra or {})
         self.rows_written = 0
         self.rotations = 0
@@ -124,13 +188,10 @@ class FlightRecorder:
                 self._rotate()
 
     def _rotate(self) -> None:
-        """Cap the file: current generation moves to `<path>.1` (the
-        previous `.1` is dropped), appends continue fresh."""
+        """Cap the file: the generation chain shifts one step (the
+        oldest past `rotate` is dropped), appends continue fresh."""
         self._f.close()
-        try:
-            os.replace(self.path, self.path + ".1")
-        except OSError:
-            pass
+        rotate_files(self.path, self.rotate)
         self._f = open(self.path, "a")
         self.rotations += 1
 
@@ -138,14 +199,15 @@ class FlightRecorder:
 # -- module registry (the obs.finish / exit-flush seam) ----------------
 def start(path: str, interval: float = DEFAULT_INTERVAL,
           max_rows: int = DEFAULT_MAX_ROWS,
-          extra: Optional[Dict[str, Any]] = None) -> FlightRecorder:
+          extra: Optional[Dict[str, Any]] = None,
+          rotate: int = DEFAULT_ROTATE) -> FlightRecorder:
     """Start (or return the already-running) recorder for `path`."""
     with _REG_LOCK:
         rec = _ACTIVE.get(path)
         if rec is not None:
             return rec
         rec = FlightRecorder(path, interval=interval, max_rows=max_rows,
-                             extra=extra)
+                             extra=extra, rotate=rotate)
         _ACTIVE[path] = rec
         _EVER.add(path)
     rec.start()

@@ -35,7 +35,28 @@ from typing import Any, Dict, List, NamedTuple, Optional
 from . import core, journal, metrics
 
 __all__ = ["QualityConfig", "QualityMonitor", "SessionQuality",
-           "attach", "detach", "replay", "Z50", "Z95"]
+           "attach", "detach", "replay", "add_alert_sink",
+           "remove_alert_sink", "Z50", "Z95"]
+
+# alert fan-out beyond the local obs plane: each sink receives every
+# PUBLISHED alert record (live monitors only — offline `replay` keeps
+# its alerts in `.alerts` and never calls sinks, preserving the
+# exactness contract's purity).  The fleet-telemetry shipper
+# (obs/ship.py, ISSUE 14) registers here so `obs.alert` events reach
+# the hub the moment they fire, not a window later.
+_ALERT_SINKS: List[Any] = []
+
+
+def add_alert_sink(fn) -> None:
+    if fn not in _ALERT_SINKS:
+        _ALERT_SINKS.append(fn)
+
+
+def remove_alert_sink(fn) -> None:
+    try:
+        _ALERT_SINKS.remove(fn)
+    except ValueError:
+        pass
 
 # two-sided standard-normal quantiles for the nominal 50% / 95%
 # predictive intervals the coverage gauges score
@@ -162,6 +183,11 @@ class QualityMonitor:
         if self.publish:
             core.event("obs.alert", **rec)
             metrics.count("search.alerts")
+            for fn in list(_ALERT_SINKS):
+                try:
+                    fn(rec)
+                except Exception:   # a sink must never fail the search
+                    pass
 
     # -- row dispatch --------------------------------------------------
     def on_row(self, row: Dict[str, Any]) -> None:

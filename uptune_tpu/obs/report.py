@@ -13,6 +13,16 @@ disagree with what `ut top` showed during the run.
     ut report out.journal.jsonl                    # -> .report.html
     ut report out.journal.jsonl --format md -o -   # markdown to stdout
     ut report j.jsonl --metrics trace.json.metrics.jsonl
+    ut report 'out.journal.h*.jsonl'               # multi-replica
+    ut report ut.fleet.jsonl                       # hub fleet timeline
+
+Multi-source journals (ISSUE 14): several journal files (repeatable
+positionals, glob-expanded — e.g. the ``.hN`` files every
+``--num-hosts`` replica writes) or ONE hub fleet timeline
+(``ut hub --timeline``, detected by its header; each source's shipped
+journal rows are split back out) render a single document with a
+fleet summary table and per-source attribution sections, each
+replayed through the same exact `quality.replay` path.
 
 The HTML is fully self-contained (inline SVG + CSS, no scripts, no
 network), so it can be committed next to a bench artifact or attached
@@ -24,8 +34,10 @@ the table carrying the same data.
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import html as _html
 import json
+import os
 import sys
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -33,7 +45,8 @@ from . import journal as journal_mod
 from . import quality as quality_mod
 
 __all__ = ["analyze", "render", "render_html", "render_markdown",
-           "summarize_metrics", "device_summary", "main"]
+           "render_multi", "read_sources", "summarize_metrics",
+           "device_summary", "main"]
 
 # nominal two-sided central-interval levels for the reliability table
 # (z quantiles of the standard normal)
@@ -506,6 +519,32 @@ _CSS = """
 """
 
 
+def _report_css() -> str:
+    """The one CSS block both HTML renderers (single-source and
+    fleet) embed — styling fixes land once."""
+    series_css = "\n".join(
+        f".viz-root .s{i} {{ fill: var(--s{i}); }}\n"
+        f".viz-root .s{i}-sw {{ background: var(--s{i}); }}"
+        for i in range(8))
+    return _CSS.format(
+        light="\n  ".join(f"--s{i}: {c};"
+                          for i, c in enumerate(_SERIES_LIGHT)),
+        dark="\n    ".join(f"--s{i}: {c};"
+                           for i, c in enumerate(_SERIES_DARK)),
+        series_css=series_css)
+
+
+def _table_html(headers, rows_) -> str:
+    """Escaped HTML table — the shared cell-escaping path of both
+    renderers."""
+    h = "".join(f"<th>{_html.escape(str(c))}</th>" for c in headers)
+    b = "".join(
+        "<tr>" + "".join(f"<td>{_html.escape(str(c))}</td>"
+                         for c in row) + "</tr>"
+        for row in rows_)
+    return f"<table><tr>{h}</tr>{b}</table>"
+
+
 def render_html(an: Dict[str, Any],
                 met: Optional[Dict[str, Any]] = None) -> str:
     import time as _time
@@ -514,24 +553,8 @@ def render_html(an: Dict[str, Any],
     when = (_time.strftime("%Y-%m-%d %H:%M:%S",
                            _time.gmtime(origin)) + " UTC"
             if origin else "—")
-    series_css = "\n".join(
-        f".viz-root .s{i} {{ fill: var(--s{i}); }}\n"
-        f".viz-root .s{i}-sw {{ background: var(--s{i}); }}"
-        for i in range(8))
-    css = _CSS.format(
-        light="\n  ".join(f"--s{i}: {c};"
-                          for i, c in enumerate(_SERIES_LIGHT)),
-        dark="\n    ".join(f"--s{i}: {c};"
-                           for i, c in enumerate(_SERIES_DARK)),
-        series_css=series_css)
-
-    def table(headers, rows_):
-        h = "".join(f"<th>{_html.escape(str(c))}</th>" for c in headers)
-        b = "".join(
-            "<tr>" + "".join(f"<td>{_html.escape(str(c))}</td>"
-                             for c in row) + "</tr>"
-            for row in rows_)
-        return f"<table><tr>{h}</tr>{b}</table>"
+    css = _report_css()
+    table = _table_html
 
     parts = [
         "<!doctype html><html><head><meta charset='utf-8'>",
@@ -622,6 +645,157 @@ def render(journal_path: str, metrics_path: Optional[str] = None,
     return render_html(an, met)
 
 
+# ------------------------------------------------- multi-source (ISSUE 14)
+def _is_fleet_timeline(path: str) -> bool:
+    """A hub fleet timeline announces itself with a ``{"fleet": 1}``
+    header line (obs/hub.py); a plain journal starts with
+    ``{"journal": 1}``."""
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    return False
+                return isinstance(rec, dict) and "fleet" in rec
+    except OSError:
+        pass
+    return False
+
+
+def read_fleet(path: str) -> List[Tuple[str, Dict[str, Any],
+                                        List[Dict[str, Any]]]]:
+    """Split a hub fleet timeline (including its rotation chain) back
+    into per-source journal streams: ``[(source_label, header, rows),
+    ...]``.  Only ``kind == "journal"`` rows participate — window
+    snapshots and health rollups are system-plane telemetry the
+    quality replay has no use for."""
+    from . import flight
+    origin = None
+    per: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in flight.read_chain(path):
+        if "fleet" in rec:
+            origin = origin or rec.get("origin_unix")
+            continue
+        if rec.get("kind") != "journal":
+            continue
+        row = rec.get("row")
+        if isinstance(row, dict) and "ev" in row:
+            per.setdefault(str(rec.get("src")), []).append(row)
+    return [(src,
+             {"journal": journal_mod.SCHEMA_VERSION,
+              "origin_unix": origin,
+              "meta": {"source": src,
+                       "fleet": os.path.basename(path)}},
+             rows)
+            for src, rows in sorted(per.items())]
+
+
+def read_sources(paths: List[str]
+                 ) -> List[Tuple[str, Dict[str, Any],
+                                 List[Dict[str, Any]]]]:
+    """Normalize the CLI's positional(s) into per-source journal
+    streams.  One fleet timeline expands into its shipped sources;
+    journal files contribute one source each, labeled by basename."""
+    if len(paths) == 1 and _is_fleet_timeline(paths[0]):
+        return read_fleet(paths[0])
+    out = []
+    for p in paths:
+        header, rows = journal_mod.read(p)
+        out.append((os.path.basename(p), header, rows))
+    return out
+
+
+def _source_summary_row(label: str, an: Dict[str, Any]) -> List[Any]:
+    mon = an["mon"]
+    tells = [r for r in an["tells"] if r.get("ok")]
+    best = next((r["best"] for r in reversed(an["tells"])
+                 if r.get("best") is not None), None)
+    return [label, len(tells),
+            _fmt(best) if best is not None else "—",
+            sum(1 for r in an["tells"] if r.get("new_best")),
+            len(mon.alerts), an["store_hits"]]
+
+
+_FLEET_HEADERS = ("source", "tells", "best", "new bests", "alerts",
+                  "store hits")
+
+
+def render_multi(sources: List[Tuple[str, Dict[str, Any],
+                                     List[Dict[str, Any]]]],
+                 fmt: str = "html",
+                 config: Optional[quality_mod.QualityConfig] = None
+                 ) -> str:
+    """One document over several sources: a fleet summary table, then
+    per-source attribution (summary, arm table, convergence chart in
+    HTML, alerts) — every source replayed through the same
+    `quality.replay` path as the single-source report."""
+    ans = [(label, analyze(header, rows, config))
+           for label, header, rows in sources]
+    if fmt == "md":
+        lines = ["# ut report — fleet", "",
+                 f"{len(ans)} sources", "", "## Sources", "",
+                 "| " + " | ".join(_FLEET_HEADERS) + " |",
+                 "|" + "---|" * len(_FLEET_HEADERS)]
+        for label, an in ans:
+            lines.append("| " + " | ".join(
+                str(c) for c in _source_summary_row(label, an)) + " |")
+        for label, an in ans:
+            lines += ["", f"## Source: {label}", "",
+                      "| metric | value |", "|---|---|"]
+            lines += [f"| {k} | {v} |"
+                      for k, v in _summary_pairs(an, None)]
+            lines += ["", "| arm | pulls | evals | new bests | "
+                          "evals share | best share |",
+                      "|---|---|---|---|---|---|"]
+            for row in _arm_table(an):
+                lines.append("| " + " | ".join(str(c) for c in row)
+                             + " |")
+            mon = an["mon"]
+            if mon.alerts:
+                lines += ["", "| t (s) | kind |", "|---|---|"]
+                lines += [f"| {a['t']:.1f} | {a['kind']} |"
+                          for a in mon.alerts]
+        return "\n".join(lines) + "\n"
+
+    # html: the single-source document's shared CSS + table helpers
+    css = _report_css()
+    table = _table_html
+    parts = [
+        "<!doctype html><html><head><meta charset='utf-8'>",
+        "<title>ut report — fleet</title>",
+        f"<style>{css}</style></head><body class='viz-root'>",
+        "<h1>ut report — fleet</h1>",
+        f"<p class='meta'>{len(ans)} sources</p>",
+        "<h2>Sources</h2>",
+        table(_FLEET_HEADERS,
+              [_source_summary_row(label, an) for label, an in ans]),
+    ]
+    for label, an in ans:
+        parts += [f"<h2>Source: {_html.escape(label)}</h2>",
+                  table(("metric", "value"), _summary_pairs(an, None))]
+        conv = _svg_convergence(an)
+        if conv:
+            parts.append(conv)
+        parts.append(table(("arm", "pulls", "evals", "new bests",
+                            "evals share", "best share"),
+                           _arm_table(an)))
+        mon = an["mon"]
+        if mon.alerts:
+            parts.append(table(
+                ("t (s)", "kind", "detail"),
+                [(f"{a['t']:.1f}", a["kind"],
+                  json.dumps({k: v for k, v in a.items()
+                              if k not in ("kind", "t")},
+                             sort_keys=True))
+                 for a in mon.alerts]))
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
@@ -629,22 +803,42 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="render a tuning journal into a self-contained "
                     "search-quality report (docs/OBSERVABILITY.md "
                     "'Search-quality telemetry')")
-    p.add_argument("journal", help="tuning journal JSONL "
-                                   "(ut --journal / ut serve --journal)")
+    p.add_argument("journal", nargs="+",
+                   help="tuning journal JSONL(s) (ut --journal / "
+                        "ut serve --journal; repeatable and "
+                        "glob-expanded, e.g. 'out.jsonl.h*') — or ONE "
+                        "hub fleet timeline (ut hub --timeline), "
+                        "split back into its shipped per-source "
+                        "journal streams")
     p.add_argument("--metrics", default=None, metavar="JSONL",
                    help="optional flight-recorder metrics timeline to "
-                        "fold in (system-plane peak rates)")
+                        "fold in (system-plane peak rates; "
+                        "single-source reports only)")
     p.add_argument("--format", choices=("html", "md"), default="html")
     p.add_argument("-o", "--out", default=None,
                    help="output path ('-' = stdout; default "
                         "<journal>.report.<fmt>)")
     args = p.parse_args(argv)
+    paths: List[str] = []
+    for pat in args.journal:
+        hits = sorted(_glob.glob(pat)) or [pat]
+        for h in hits:
+            if h not in paths:
+                paths.append(h)
     try:
-        text = render(args.journal, args.metrics, args.format)
+        if len(paths) == 1 and not _is_fleet_timeline(paths[0]):
+            text = render(paths[0], args.metrics, args.format)
+        else:
+            sources = read_sources(paths)
+            if not sources:
+                print(f"ut report: no journal rows in {paths}",
+                      file=sys.stderr)
+                return 1
+            text = render_multi(sources, args.format)
     except (OSError, ValueError) as e:
         print(f"ut report: {e}", file=sys.stderr)
         return 1
-    out = args.out or f"{args.journal}.report.{args.format}"
+    out = args.out or f"{paths[0]}.report.{args.format}"
     if out == "-":
         sys.stdout.write(text)
     else:

@@ -9,7 +9,20 @@ Two data sources, one view:
   timeline on disk (any traced run: `ut serve`, `ut prog.py --trace`,
   bench.py) — rows already carry per-window deltas, so rates read
   straight off the newest row.  Works on a LIVE file and post-mortem
-  on a crashed run's tail alike.
+  on a crashed run's tail alike.  ``--metrics`` repeats and accepts
+  globs (``'out.json.metrics.jsonl*'`` picks up ``.hN`` replica
+  files): several files render as ONE fleet-rolled frame
+  (obs.hub.fleet_rollup — counters summed, gauges last-write,
+  labeled-approximate percentiles) with each row labeled per source.
+
+Since ISSUE 14 ``--addr`` may also point at a fleet-telemetry hub
+(`ut hub`): its metrics op serves the fleet rollup in the same scrape
+shape, so the frame just works; ``--fleet`` adds a per-source panel
+(one line per shipping process: age, rates, drops, alerts) fed by the
+hub's ``sources`` op — or derived per file in multi ``--metrics``
+mode.  The tail reader follows the flight recorder's rotation chain
+(``<file>.N`` … ``<file>.1``), so a freshly rotated timeline still
+yields a full frame.
 
 The frame shows the serving plane's vitals: active sessions, epoch
 batch fill, ask/tell rates and latency percentiles, worker-pool
@@ -27,12 +40,14 @@ vanished server.
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["Sample", "rates", "render", "main"]
+__all__ = ["Sample", "rates", "render", "fleet_lines", "main"]
 
 CLEAR = "\x1b[H\x1b[2J"
 
@@ -56,12 +71,15 @@ class Sample:
 
 
 def sample_from_scrape(resp: Dict[str, Any]) -> Sample:
-    """A serve `{"op": "metrics"}` response -> Sample."""
+    """A serve (or hub — same shape, plus fleet window deltas and a
+    source count) `{"op": "metrics"}` response -> Sample."""
     m = resp.get("metrics", {}) or {}
     return Sample(time.time(), m.get("counters", {}) or {},
                   m.get("gauges", {}) or {}, m.get("hists", {}) or {},
+                  deltas=m.get("deltas"), dt=m.get("dt") or None,
                   meta={"sessions": resp.get("sessions"),
-                        "uptime_s": resp.get("uptime_s")})
+                        "uptime_s": resp.get("uptime_s"),
+                        "sources": resp.get("sources")})
 
 
 def sample_from_row(row: Dict[str, Any]) -> Sample:
@@ -78,13 +96,8 @@ def sample_from_row(row: Dict[str, Any]) -> Sample:
 TAIL_BYTES = 256 * 1024
 
 
-def last_rows(path: str, n: int = 2) -> List[Dict[str, Any]]:
-    """The last `n` parseable rows of a metrics JSONL (tail-tolerant:
-    a row being appended right now is skipped).  Reads only the final
-    `TAIL_BYTES` of the file — a rotation-capped timeline near 20k
-    rows is megabytes, and the refresh loop calls this every couple
-    of seconds; the first (possibly truncated) line of a mid-file
-    seek fails to parse and is skipped like any torn row."""
+def _tail_rows(path: str, n: int) -> List[Dict[str, Any]]:
+    """Newest-first parseable rows from ONE file's tail."""
     try:
         with open(path, "rb") as f:
             f.seek(0, 2)
@@ -103,6 +116,27 @@ def last_rows(path: str, n: int = 2) -> List[Dict[str, Any]]:
             continue
         if isinstance(row, dict) and "counters" in row:
             out.append(row)
+    return out
+
+
+def last_rows(path: str, n: int = 2) -> List[Dict[str, Any]]:
+    """The last `n` parseable rows of a metrics JSONL (tail-tolerant:
+    a row being appended right now is skipped).  Reads only the final
+    `TAIL_BYTES` of each file — a rotation-capped timeline near 20k
+    rows is megabytes, and the refresh loop calls this every couple
+    of seconds; the first (possibly truncated) line of a mid-file
+    seek fails to parse and is skipped like any torn row.  When the
+    live file holds fewer than `n` rows (it just rotated), older
+    rotation generations (``<path>.1`` … ``<path>.N``) fill in, so a
+    freshly capped timeline still renders a full frame."""
+    out = _tail_rows(path, n)
+    gen = 1
+    while len(out) < n:
+        older = _tail_rows(f"{path}.{gen}", n - len(out))
+        if not older:
+            break
+        out.extend(older)
+        gen += 1
     return list(reversed(out))
 
 
@@ -132,8 +166,51 @@ def _hist_p(hists: Dict[str, Any], name: str, p: str) -> Optional[float]:
     return h.get(p) if isinstance(h, dict) else None
 
 
+def _source_row(label: str, row: Dict[str, Any]) -> Dict[str, Any]:
+    """Normalize one flight-recorder/window row into the per-source
+    panel shape (the hub's `sources` op emits the same keys, through
+    the same shared rate helper)."""
+    from .hub import window_rates
+    rates_ = window_rates(row)
+    t = float(row.get("t") or 0.0)
+    return {"source": label,
+            "age_s": round(max(0.0, time.time() - t), 1) if t else None,
+            "rates": rates_, "final": bool(row.get("final")),
+            "stale": False, "dropped": None, "alerts": None,
+            "journal_rows": None}
+
+
+def fleet_lines(sources: List[Dict[str, Any]],
+                width: int = 78) -> List[str]:
+    """The per-source panel (`--fleet`): one labeled line per shipping
+    process / metrics file, worst (stale) first."""
+    out = [f"sources   ({len(sources)})"]
+    rows = sorted(sources, key=lambda r: (not r.get("stale"),
+                                          str(r.get("source"))))
+    for r in rows:
+        rate = r.get("rates") or {}
+        main_rate = (rate.get("serve.asks") or rate.get("driver.asks")
+                     or rate.get("serve.tells"))
+        flags = []
+        if r.get("stale"):
+            flags.append("STALE")
+        if r.get("final"):
+            flags.append("final")
+        out.append(
+            ("  {:<30}{:>7}s {:>9}/s  drop{:>4}  alrt{:>3} {}")
+            .format(
+                str(r.get("source"))[:30],
+                _fmt(r.get("age_s")),
+                _fmt(main_rate),
+                _fmt(r.get("dropped"), nd=0),
+                _fmt(r.get("alerts"), nd=0),
+                " ".join(flags))[:width].rstrip())
+    return out
+
+
 def render(prev: Optional[Sample], cur: Sample, source: str,
-           width: int = 78) -> str:
+           width: int = 78,
+           sources: Optional[List[Dict[str, Any]]] = None) -> str:
     """One dashboard frame as text (pure: testable without a tty)."""
     r = rates(prev, cur)
     c, g, h = cur.counters, cur.gauges, cur.hists
@@ -146,6 +223,8 @@ def render(prev: Optional[Sample], cur: Sample, source: str,
         time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(cur.t))
         + (f"   up {up:,.0f}s" if up is not None else "")
         + (f"   window {cur.dt:.2f}s" if cur.dt else "")
+        + (f"   sources {cur.meta['sources']}"
+           if cur.meta.get("sources") is not None else "")
         + ("   [FINAL]" if cur.meta.get("final") else ""),
         "-" * min(width, 60),
         "serve     sessions {}   batch fill {}   groups+ {}".format(
@@ -227,6 +306,8 @@ def render(prev: Optional[Sample], cur: Sample, source: str,
     if extras:
         lines.append("also      " + "   ".join(
             f"{k} {_fmt(v)}/s" for v, k in extras)[:width - 10])
+    if sources is not None:
+        lines += fleet_lines(sources, width)
     return "\n".join(lines)
 
 
@@ -240,11 +321,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     src = p.add_mutually_exclusive_group()
     src.add_argument("--addr", default=None, metavar="HOST:PORT",
                      help="poll a running `ut serve` process's "
-                          "metrics op (default: the configured "
+                          "metrics op — or a fleet-telemetry hub "
+                          "(`ut hub`), whose scrape is the live "
+                          "fleet rollup (default: the configured "
                           "serve-host:serve-port)")
     src.add_argument("--metrics", default=None, metavar="JSONL",
-                     help="tail a flight-recorder metrics timeline "
-                          "instead of polling a server")
+                     action="append",
+                     help="tail flight-recorder metrics timeline(s) "
+                          "instead of polling a server.  Repeatable "
+                          "and glob-expanded ('out.json.metrics"
+                          ".jsonl*' includes .hN replica files); "
+                          "several files render one fleet-rolled "
+                          "frame with per-source labels")
+    p.add_argument("--fleet", action="store_true",
+                   help="add the per-source panel: one labeled line "
+                        "per shipping process (hub `sources` op) or "
+                        "per metrics file")
     p.add_argument("--interval", type=float, default=2.0,
                    help="refresh cadence in seconds (default 2)")
     p.add_argument("--once", action="store_true",
@@ -261,25 +353,64 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     client = None
     prev: Optional[Sample] = None
+    # glob-expanded, order-stable, deduped metrics path set (an
+    # unmatched pattern stays literal: the file may appear later)
+    mpaths: List[str] = []
+    for pat in (args.metrics or []):
+        hits = sorted(_glob.glob(pat)) or [pat]
+        for h in hits:
+            if h not in mpaths:
+                mpaths.append(h)
 
-    def poll() -> Tuple[Optional[Sample], str]:
+    def poll() -> Tuple[Optional[Sample], str,
+                        Optional[List[Dict[str, Any]]]]:
         nonlocal client
-        if args.metrics:
-            rows = last_rows(args.metrics, 2)
-            if not rows:
-                return None, args.metrics
-            return sample_from_row(rows[-1]), args.metrics
-        from ..serve.client import connect
+        if mpaths:
+            if len(mpaths) == 1:
+                # single file: the historical exact-window frame (one
+                # tail read per tick)
+                rows = last_rows(mpaths[0], 2)
+                if not rows:
+                    return None, mpaths[0], None
+                srcs = ([_source_row(os.path.basename(mpaths[0]),
+                                     rows[-1])]
+                        if args.fleet else None)
+                return sample_from_row(rows[-1]), mpaths[0], srcs
+            per: List[Tuple[str, Dict[str, Any]]] = []
+            for path in mpaths:
+                rows = last_rows(path, 1)
+                if rows:
+                    per.append((os.path.basename(path), rows[-1]))
+            label = f"{len(mpaths)} metrics files"
+            if not per:
+                return None, label, None
+            from .hub import fleet_rollup
+            roll = fleet_rollup(per)
+            cur = Sample(
+                max(float(r.get("t") or 0.0) for _, r in per),
+                roll["counters"], roll["gauges"], roll["hists"],
+                deltas=roll["deltas"], dt=roll["dt"] or None,
+                meta={"sources": len(per)})
+            srcs = ([_source_row(lbl, row) for lbl, row in per]
+                    if args.fleet else None)
+            return cur, label, srcs
+        from ..serve.client import ServeError, connect
         if client is None:
             client = connect(args.addr)
         resp = client.metrics()
+        srcs = None
+        if args.fleet:
+            try:
+                srcs = client.request("sources").get("rows")
+            except ServeError:
+                srcs = None     # a session server: no sources op
         return (sample_from_scrape(resp),
-                f"{client.host}:{client.port}")
+                f"{client.host}:{client.port}", srcs)
 
     try:
         while True:
             try:
-                cur, source = poll()
+                cur, source, srcs = poll()
             except (OSError, ValueError, RuntimeError) as e:
                 print(f"ut top: {e}", file=sys.stderr)
                 return 1
@@ -290,15 +421,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     return 1
             else:
                 if args.once and args.json:
-                    print(json.dumps(
-                        {"t": cur.t, "source": source,
-                         "counters": cur.counters,
-                         "gauges": cur.gauges, "hists": cur.hists,
-                         "rates": rates(prev, cur),
-                         "window_s": cur.dt, "meta": cur.meta},
-                        sort_keys=True))
+                    frame_obj = {"t": cur.t, "source": source,
+                                 "counters": cur.counters,
+                                 "gauges": cur.gauges,
+                                 "hists": cur.hists,
+                                 "rates": rates(prev, cur),
+                                 "window_s": cur.dt, "meta": cur.meta}
+                    if srcs is not None:
+                        frame_obj["sources"] = srcs
+                    print(json.dumps(frame_obj, sort_keys=True))
                     return 0
-                frame = render(prev, cur, source)
+                frame = render(prev, cur, source, sources=srcs)
                 if args.once:
                     print(frame)
                     return 0

@@ -26,7 +26,40 @@ Surface: ``ut serve`` (CLI), ``uptune_tpu.connect()`` -> SessionClient
 — the same session mechanics without a server, which doubles as the
 matched-seed offline sibling the parity tests hold the server to.
 """
-from .client import SessionClient, SessionHandle, ServeError, Trial, connect  # noqa: F401
-from .group import SessionGroup, group_key  # noqa: F401
-from .session import LocalSession, Session, StaleTicketError  # noqa: F401
-from .server import SessionServer  # noqa: F401
+# Lazy surface (the uptune_tpu/__init__ pattern): the wire kernel
+# (serve/wire.py) and its light consumers — the fleet-telemetry hub,
+# `ut top`'s poller, SessionClient — must stay importable without
+# paying the engine/jax import the session modules pull in.
+_LAZY = {
+    "SessionClient": ("uptune_tpu.serve.client", "SessionClient"),
+    "SessionHandle": ("uptune_tpu.serve.client", "SessionHandle"),
+    "ServeError": ("uptune_tpu.serve.client", "ServeError"),
+    "Trial": ("uptune_tpu.serve.client", "Trial"),
+    "connect": ("uptune_tpu.serve.client", "connect"),
+    "SessionGroup": ("uptune_tpu.serve.group", "SessionGroup"),
+    "group_key": ("uptune_tpu.serve.group", "group_key"),
+    "LocalSession": ("uptune_tpu.serve.session", "LocalSession"),
+    "Session": ("uptune_tpu.serve.session", "Session"),
+    "StaleTicketError": ("uptune_tpu.serve.session", "StaleTicketError"),
+    "SessionServer": ("uptune_tpu.serve.server", "SessionServer"),
+    "RequestError": ("uptune_tpu.serve.wire", "RequestError"),
+    "WireServer": ("uptune_tpu.serve.wire", "WireServer"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+    value = getattr(importlib.import_module(mod_name), attr)
+    globals()[name] = value     # cache: resolve once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))

@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 from typing import List, Optional
 
 log = logging.getLogger("uptune_tpu")
@@ -57,6 +58,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "server's metrics timeline (default 1.0; 0 "
                         "disables).  `ut top --metrics "
                         "OUT.json.metrics.jsonl` tails it live")
+    p.add_argument("--metrics-rotate", type=int, default=None,
+                   metavar="N",
+                   help="flight-recorder rotation depth: generations "
+                        "kept past the row cap (default 1)")
+    p.add_argument("--telemetry", default=None, metavar="HOST:PORT",
+                   help="ship this server's metrics windows, journal "
+                        "rows, alerts AND its `{\"op\": \"health\"}` "
+                        "session rollup to a running `ut hub` "
+                        "collector (docs/OBSERVABILITY.md 'Fleet "
+                        "telemetry').  Also reachable via "
+                        "UT_TELEMETRY or ut.config({'telemetry': "
+                        "...}); 'off' disables")
     p.add_argument("--journal", default=None, metavar="OUT.jsonl",
                    help="tuning journal (docs/OBSERVABILITY.md "
                         "'Search-quality telemetry'): one JSONL row "
@@ -124,7 +137,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         mi = (args.metrics_interval if args.metrics_interval is not None
               else 1.0)
         if mi > 0:
-            obs.start_flight_recorder(trace_path, interval=mi)
+            obs.start_flight_recorder(
+                trace_path, interval=mi,
+                rotate=(args.metrics_rotate
+                        if args.metrics_rotate is not None
+                        else obs.flight.DEFAULT_ROTATE))
 
     # tuning journal (ISSUE 12): per-tenant serve_tell rows + the
     # derived search-quality gauges (which the metrics op and `ut top`
@@ -147,9 +164,41 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from .server import SessionServer
     srv = SessionServer(**resolve_config(args))
+
+    # fleet telemetry (docs/OBSERVABILITY.md "Fleet telemetry"): flag
+    # > UT_TELEMETRY env > ut.config('telemetry').  The serving
+    # process additionally ships its session-health rollup, so the
+    # hub's `health` op sees per-tenant verdicts fleet-wide
+    shipper = None
+    telemetry = args.telemetry
+    if telemetry is None:
+        telemetry = os.environ.get("UT_TELEMETRY", "").strip() or None
+        if telemetry is None:
+            from ..api.session import settings
+            cfg_t = settings["telemetry"]
+            if not obs.ship.disabled_token(cfg_t):
+                telemetry = str(cfg_t)
+    if obs.ship.disabled_token(telemetry):
+        telemetry = None
+    if telemetry:
+        shipper = obs.ship.start(
+            telemetry, role="ut-serve",
+            health_provider=lambda: srv._op_health({}))
+        # telemetry-only servers (no --trace/--journal) still need
+        # the SIGINT/SIGTERM hooks: the exit flush's ship.stop()
+        # ships the final window, and the chained handler unwinds
+        # serve_forever into the finally below (idempotent)
+        obs.install_exit_flush(None)
+
     try:
         srv.serve_forever()
     finally:
+        if shipper is not None:
+            shipper.stop()
+            st = shipper.stats()
+            log.info("[ut-serve] telemetry shipped to %s:%s (%d rows "
+                     "acked, %d dropped)", shipper.addr[0],
+                     shipper.addr[1], st["acked"], st["dropped"])
         if dtrace:
             obs.device.stop_trace()
             log.info("[ut-serve] device profile captured under %s",

@@ -14,7 +14,11 @@ connection, every request one JSON object with an ``op`` field.
 ``SessionServer.handle(request) -> response`` is the transport-free
 dispatch (tests and the in-process bench drive it directly); the TCP
 layer is one reader/writer loop around it.  An optional ``id`` field
-is echoed verbatim so clients may pipeline.
+is echoed verbatim so clients may pipeline.  Since ISSUE 14 the
+generic half — dispatch table, per-op error walls, the accept /
+reader loops, connection reaping — lives in ``serve.wire.WireServer``
+(shared with the fleet-telemetry hub, obs/hub.py); this module owns
+only the session-plane ops and registries.
 
 Tenant grouping happens at ``open``: the request's space records are
 rebuilt into a Space, and sessions whose ``group_key`` matches share
@@ -29,11 +33,8 @@ this is an in-cluster serving plane, not an internet-facing one
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
-import socket
-import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -44,12 +45,9 @@ from ..exec.space_io import space_from_params
 from ..store.store import ResultStore
 from .group import SessionGroup, group_key
 from .session import Session, StaleTicketError
+from .wire import RequestError, WireServer  # noqa: F401  (re-export)
 
 log = logging.getLogger("uptune_tpu")
-
-
-class RequestError(ValueError):
-    """Bad request payload (reported to the client, never fatal)."""
 
 
 def _resolve(value, key):
@@ -58,10 +56,12 @@ def _resolve(value, key):
     return settings[key] if value is None else value
 
 
-class SessionServer:
+class SessionServer(WireServer):
     """One serving process.  Construct, ``start()``, ``connect()``
     clients against ``.port``, ``stop()``.  All constructor parameters
     default through the ``serve-*`` ut.config keys."""
+
+    WIRE_NAME = "ut-serve"
 
     def __init__(self, host: Optional[str] = None,
                  port: Optional[int] = None,
@@ -69,8 +69,8 @@ class SessionServer:
                  max_sessions: Optional[int] = None,
                  store_dir: Optional[str] = None,
                  work_dir: Optional[str] = None):
-        self.host = str(_resolve(host, "serve-host"))
-        self.port = int(_resolve(port, "serve-port"))
+        super().__init__(str(_resolve(host, "serve-host")),
+                         int(_resolve(port, "serve-port")))
         self.slots = int(_resolve(slots, "serve-slots"))
         self.max_sessions = int(_resolve(max_sessions,
                                          "serve-max-sessions"))
@@ -82,17 +82,12 @@ class SessionServer:
             sd = os.path.join(self.work_dir, "ut.serve", "store")
         self.store_dir = (None if str(sd).lower() in ("off", "none")
                           else os.path.abspath(str(sd)))
-        self._lock = threading.RLock()      # registries only
+        # self._lock (WireServer) guards the registries below too
         self._groups: Dict[Tuple, List[SessionGroup]] = {}
         self._glocks: Dict[Tuple, threading.Lock] = {}
         self._admitted = 0      # admission reservations (<= max)
         self._sessions: Dict[str, Session] = {}
         self._stores: Dict[Tuple, ResultStore] = {}
-        self._listener: Optional[socket.socket] = None
-        self._threads: List[threading.Thread] = []
-        self._conns: List[socket.socket] = []
-        self._running = False
-        self.started_unix = time.time()
         # the metrics registry only records while the obs plane is
         # enabled; a serving process keeps it on so the scrape op (and
         # BENCH_SERVE's evidence) always has data.  Span rings are
@@ -334,6 +329,7 @@ class SessionServer:
     HEALTH_STALL_TELLS = 64
     HEALTH_FAIL_RATE_HI = 0.5
     HEALTH_MAX_SESSIONS = 64
+    HEALTH_LIMIT_CAP = 1024
 
     def _op_health(self, req: dict) -> dict:
         """Per-session search-quality verdicts (ISSUE 12): with a
@@ -341,14 +337,22 @@ class SessionServer:
         roll-up over every live session — what a sharded front tier
         (ROADMAP item 1) polls to decide placement/eviction.  Optional
         ``stall_tells`` / ``fail_rate_hi`` override the thresholds for
-        this request only (docs/SERVING.md)."""
+        this request only; ``limit`` bounds the roll-up payload
+        (default 64, capped at ``HEALTH_LIMIT_CAP`` so one request
+        can never serialize an unbounded session table —
+        docs/SERVING.md)."""
         try:
             stall = int(req.get("stall_tells", self.HEALTH_STALL_TELLS))
             frh = float(req.get("fail_rate_hi",
                                 self.HEALTH_FAIL_RATE_HI))
+            limit = int(req.get("limit", self.HEALTH_MAX_SESSIONS))
         except (TypeError, ValueError) as e:
             raise RequestError(
-                f"stall_tells/fail_rate_hi must be numbers: {e}")
+                f"stall_tells/fail_rate_hi/limit must be numbers: {e}")
+        if not 1 <= limit <= self.HEALTH_LIMIT_CAP:
+            raise RequestError(
+                f"limit must be in [1, {self.HEALTH_LIMIT_CAP}]: "
+                f"{limit}")
         if req.get("session") is not None:
             return {"health": self._session(req).health(
                 stall_tells=stall, fail_rate_hi=frh)}
@@ -365,10 +369,9 @@ class SessionServer:
         rank = {"failing": 0, "stalled": 1, "cold": 2, "ok": 3}
         rows.sort(key=lambda r: (rank.get(r["status"], 4),
                                  r["session"]))
-        truncated = len(rows) > self.HEALTH_MAX_SESSIONS
         return {"sessions": len(rows), "by_status": by_status,
-                "truncated": truncated,
-                "health": rows[:self.HEALTH_MAX_SESSIONS]}
+                "truncated": len(rows) > limit,
+                "health": rows[:limit]}
 
     def _op_stats(self, req: dict) -> dict:
         with self._lock:
@@ -389,171 +392,37 @@ class SessionServer:
             "metrics": _op_metrics, "stats": _op_stats,
             "health": _op_health}
 
-    def handle(self, req: Any) -> dict:
-        """Transport-free dispatch: one request dict -> one response
-        dict (never raises; errors come back as ok=False).
+    # -- wire hooks (serve/wire.py owns dispatch + the TCP loops) ------
+    def _listen_banner(self) -> str:
+        return (f" (slots={self.slots}, max-sessions="
+                f"{self.max_sessions}, store={self.store_dir or 'off'})")
 
-        An optional ``ctx`` object (``{"span": id}``) is the client's
-        trace context: the handler span records it as ``parent``, so
-        a merged client+server trace joins each ``client.request``
-        span to the ``serve.handle`` span it paid for — wire time is
-        the difference (docs/OBSERVABILITY.md)."""
-        if not isinstance(req, dict):
-            return {"ok": False, "error": "request must be a JSON "
-                                          "object"}
-        rid = req.get("id")
-        op = req.get("op")
-        ctx = req.get("ctx")
-        # an unhashable op (list/dict) must hit the unknown-op reply,
-        # not TypeError out of the dict lookup before the error wall
-        fn = self._OPS.get(op) if isinstance(op, str) else None
-        if fn is None:
-            out = {"ok": False,
-                   "error": f"unknown op {op!r}; valid: "
-                            f"{sorted(self._OPS)}"}
-        else:
-            attrs = {"op": op}
-            if isinstance(ctx, dict) and ctx.get("span") is not None:
-                attrs["parent"] = str(ctx["span"])[:64]
-            with obs.span("serve.handle", **attrs) as sp:
-                try:
-                    out = {"ok": True, **fn(self, req)}
-                except RequestError as e:
-                    out = {"ok": False, "error": str(e)}
-                    sp.set(error=True)
-                except Exception as e:   # defensive: a tenant must not
-                    # be able to take the serving loop down
-                    log.exception("[ut-serve] %s failed", op)
-                    out = {"ok": False,
-                           "error": f"internal: {type(e).__name__}: {e}"}
-                    sp.set(error=True)
-        if rid is not None:
-            out["id"] = rid
-        return out
-
-    # -- TCP -----------------------------------------------------------
-    def start(self) -> "SessionServer":
-        """Bind + listen + accept loop in a daemon thread; .port holds
-        the bound port (useful with port=0)."""
-        # a serving process trades a little throughput for tail
-        # latency: the interpreter's default 5ms GIL switch interval
-        # parks every waiting request behind CPU-bound peers (config
-        # decode, JSON, a tenant thread's own measurement loop) in
-        # 5ms quanta — milliseconds of queueing on a sub-ms op.
-        # BENCH_SERVE's ask p95 is measured under this setting
-        if sys.getswitchinterval() > 0.001:
-            sys.setswitchinterval(0.0005)
-        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind((self.host, self.port))
-        s.listen(128)
-        self.port = s.getsockname()[1]
-        self._listener = s
-        self._running = True
-        t = threading.Thread(target=self._accept_loop,
-                             name="ut-serve-accept", daemon=True)
-        t.start()
-        self._threads.append(t)
-        log.info("[ut-serve] listening on %s:%d (slots=%d, "
-                 "max-sessions=%d, store=%s)", self.host, self.port,
-                 self.slots, self.max_sessions,
-                 self.store_dir or "off")
-        return self
-
-    def _accept_loop(self) -> None:
-        while self._running:
-            try:
-                conn, addr = self._listener.accept()
-            except OSError:
-                return      # listener closed
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
-            # daemon threads are not tracked: _serve_conn prunes its
-            # own conn on exit, so a long-lived server's registries
-            # stay bounded by LIVE connections under open/close churn
-            t = threading.Thread(target=self._serve_conn,
-                                 args=(conn, addr),
-                                 name=f"ut-serve-{addr[1]}",
-                                 daemon=True)
-            t.start()
-
-    def _serve_conn(self, conn: socket.socket, addr) -> None:
-        f = conn.makefile("rwb")
+    def _conn_opened(self, conn, addr) -> set:
         # session lifetime is CONNECTION-scoped: ids opened here are
         # reaped when the connection dies, so a crashed tenant cannot
         # hold its group slot and admission unit forever (a long-lived
         # server would otherwise leak to "server full" under client
         # churn).  Tracked at the transport layer — handle() stays
         # transport-free and in-process sessions are unaffected.
-        owned: set = set()
-        try:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    req = json.loads(line)
-                except json.JSONDecodeError as e:
-                    resp = {"ok": False, "error": f"bad JSON: {e}"}
-                else:
-                    resp = self.handle(req)
-                    if resp.get("ok") and isinstance(req, dict):
-                        if req.get("op") == "open":
-                            owned.add(resp["session"])
-                        elif req.get("op") == "close":
-                            owned.discard(resp.get("closed"))
-                f.write(json.dumps(resp, separators=(",", ":"))
-                        .encode() + b"\n")
-                f.flush()
-        except (OSError, ValueError):
-            pass            # client went away mid-write
-        finally:
-            try:
-                f.close()
-                conn.close()
-            except OSError:
-                pass
-            try:
-                self._conns.remove(conn)
-            except ValueError:
-                pass    # stop() already swept it
-            for sid in owned:   # best-effort: never raises
-                self.handle({"op": "close", "session": sid})
+        return set()
+
+    def _on_response(self, owned: set, req: dict, resp: dict) -> None:
+        if resp.get("ok") and isinstance(req, dict):
+            if req.get("op") == "open":
+                owned.add(resp["session"])
+            elif req.get("op") == "close":
+                owned.discard(resp.get("closed"))
+
+    def _conn_closed(self, owned: set) -> None:
+        for sid in owned:   # best-effort: never raises
+            self.handle({"op": "close", "session": sid})
 
     def stop(self) -> None:
-        self._running = False
-        if self._listener is not None:
-            try:
-                self._listener.close()
-            except OSError:
-                pass
+        super().stop()      # listener + live connections
         # snapshot under _lock: handler threads may still be mutating
-        # both registries (an open inside _store_for, an accept racing
-        # the _running flip) while shutdown walks them
+        # the registry (an open inside _store_for) while shutdown
+        # walks it
         with self._lock:
-            conns = list(self._conns)
             stores = list(self._stores.values())
-        for c in conns:
-            try:
-                c.close()
-            except OSError:
-                pass
         for st in stores:
             st.close()
-
-    def serve_forever(self) -> None:
-        """start() + block until KeyboardInterrupt (the CLI path)."""
-        self.start()
-        try:
-            while True:
-                time.sleep(3600)
-        except KeyboardInterrupt:
-            log.info("[ut-serve] shutting down")
-        finally:
-            self.stop()
-
-    def __enter__(self) -> "SessionServer":
-        return self.start()
-
-    def __exit__(self, *exc) -> None:
-        self.stop()

@@ -1,0 +1,256 @@
+"""The newline-JSON/TCP service kernel: one reusable server loop for
+every wire-speaking plane in the repo.
+
+PR 8's session server, the fleet-telemetry hub (obs/hub.py, ISSUE 14),
+and the planes ROADMAP items 1-2 specify against this seam (the
+sharded front tier, a remote ResultStore server) all speak the same
+protocol: one JSON object per line, each carrying an ``op`` field,
+answered by one JSON object per line.  This module owns the generic
+half so each service only writes its op table:
+
+* **Dispatch** — a class-level ``_OPS`` table maps op names to
+  handler methods; ``handle(request) -> response`` is transport-free
+  (tests and in-process benches drive it directly) and never raises:
+  a ``RequestError`` comes back as ``ok=False`` with the message, any
+  other exception is caught by the defensive per-op error wall and
+  reported as ``internal:`` — one misbehaving client can never take
+  the serving loop down.  An optional ``id`` field is echoed verbatim
+  so clients may pipeline; an optional ``ctx`` span id is recorded as
+  the handler span's ``parent`` so `ut-trace merge` joins
+  client/server shards (docs/OBSERVABILITY.md).
+* **Connection lifecycle** — thread-per-connection reader/writer
+  loops around ``handle()``, with per-connection state hooks
+  (``_conn_opened`` / ``_on_response`` / ``_conn_closed``) so a
+  service can scope resources to the connection that created them
+  and reap them when it dies — the session server's crashed-tenant
+  slot reaping and the hub's source liveness both ride this seam.
+* **Reaping and shutdown** — dead connections prune themselves from
+  the registry (long-lived servers stay bounded by LIVE connections
+  under churn); ``stop()`` closes the listener and every tracked
+  connection under the lock.
+
+Subclass contract::
+
+    class MyServer(WireServer):
+        WIRE_NAME = "my-server"          # log prefix + thread names
+        def _op_ping(self, req): return {"t": time.time()}
+        _OPS = {"ping": _op_ping}
+
+``HANDLE_SPAN`` stays ``serve.handle`` for every service: the trace
+merge tool joins ``client.request`` spans against that name, and a
+hub or store server is as much a serving plane as the session server.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import obs
+
+log = logging.getLogger("uptune_tpu")
+
+__all__ = ["RequestError", "WireServer"]
+
+
+class RequestError(ValueError):
+    """Bad request payload (reported to the client, never fatal)."""
+
+
+class WireServer:
+    """One wire-speaking process: construct, ``start()``, drive
+    clients against ``.port``, ``stop()``.  Subclasses own the op
+    table and any per-connection/service state."""
+
+    WIRE_NAME = "ut-wire"
+    HANDLE_SPAN = "serve.handle"
+    _OPS: Dict[str, Callable[..., dict]] = {}
+
+    def __init__(self, host: str, port: int):
+        self.host = str(host)
+        self.port = int(port)
+        self._lock = threading.RLock()
+        self._listener: Optional[socket.socket] = None
+        self._threads: List[threading.Thread] = []
+        self._conns: List[socket.socket] = []
+        self._running = False
+        self.started_unix = time.time()
+
+    # -- per-connection hooks ------------------------------------------
+    def _conn_opened(self, conn: socket.socket, addr) -> Any:
+        """Called when a connection is accepted; the return value is
+        this connection's state, threaded through `_on_response` and
+        `_conn_closed` (None by default — stateless services skip all
+        three hooks)."""
+        return None
+
+    def _on_response(self, state: Any, req: dict, resp: dict) -> None:
+        """Called after every successfully parsed request is handled
+        (bad-JSON lines never reach it)."""
+
+    def _conn_closed(self, state: Any) -> None:
+        """Called exactly once when the connection dies — the reaping
+        seam: release whatever `state` tracked.  Must never raise."""
+
+    def _listen_banner(self) -> str:
+        """Extra text for the listening log line (cosmetic)."""
+        return ""
+
+    # -- dispatch ------------------------------------------------------
+    def handle(self, req: Any) -> dict:
+        """Transport-free dispatch: one request dict -> one response
+        dict (never raises; errors come back as ok=False).
+
+        An optional ``ctx`` object (``{"span": id}``) is the client's
+        trace context: the handler span records it as ``parent``, so
+        a merged client+server trace joins each ``client.request``
+        span to the ``serve.handle`` span it paid for — wire time is
+        the difference (docs/OBSERVABILITY.md)."""
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be a JSON "
+                                          "object"}
+        rid = req.get("id")
+        op = req.get("op")
+        ctx = req.get("ctx")
+        # an unhashable op (list/dict) must hit the unknown-op reply,
+        # not TypeError out of the dict lookup before the error wall
+        fn = self._OPS.get(op) if isinstance(op, str) else None
+        if fn is None:
+            out = {"ok": False,
+                   "error": f"unknown op {op!r}; valid: "
+                            f"{sorted(self._OPS)}"}
+        else:
+            attrs = {"op": op}
+            if isinstance(ctx, dict) and ctx.get("span") is not None:
+                attrs["parent"] = str(ctx["span"])[:64]
+            with obs.span(self.HANDLE_SPAN, **attrs) as sp:
+                try:
+                    out = {"ok": True, **fn(self, req)}
+                except RequestError as e:
+                    out = {"ok": False, "error": str(e)}
+                    sp.set(error=True)
+                except Exception as e:   # defensive: a client must not
+                    # be able to take the serving loop down
+                    log.exception("[%s] %s failed", self.WIRE_NAME, op)
+                    out = {"ok": False,
+                           "error": f"internal: {type(e).__name__}: {e}"}
+                    sp.set(error=True)
+        if rid is not None:
+            out["id"] = rid
+        return out
+
+    # -- TCP -----------------------------------------------------------
+    def start(self) -> "WireServer":
+        """Bind + listen + accept loop in a daemon thread; .port holds
+        the bound port (useful with port=0)."""
+        # a serving process trades a little throughput for tail
+        # latency: the interpreter's default 5ms GIL switch interval
+        # parks every waiting request behind CPU-bound peers (config
+        # decode, JSON, a tenant thread's own measurement loop) in
+        # 5ms quanta — milliseconds of queueing on a sub-ms op.
+        # BENCH_SERVE's ask p95 is measured under this setting
+        if sys.getswitchinterval() > 0.001:
+            sys.setswitchinterval(0.0005)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(128)
+        self.port = s.getsockname()[1]
+        self._listener = s
+        self._running = True
+        t = threading.Thread(target=self._accept_loop,
+                             name=f"{self.WIRE_NAME}-accept",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("[%s] listening on %s:%d%s", self.WIRE_NAME,
+                 self.host, self.port, self._listen_banner())
+        return self
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, addr = self._listener.accept()
+            except OSError:
+                return      # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            # daemon threads are not tracked: _serve_conn prunes its
+            # own conn on exit, so a long-lived server's registries
+            # stay bounded by LIVE connections under open/close churn
+            t = threading.Thread(target=self._serve_conn,
+                                 args=(conn, addr),
+                                 name=f"{self.WIRE_NAME}-{addr[1]}",
+                                 daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket, addr) -> None:
+        f = conn.makefile("rwb")
+        state = self._conn_opened(conn, addr)
+        try:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError as e:
+                    resp = {"ok": False, "error": f"bad JSON: {e}"}
+                else:
+                    resp = self.handle(req)
+                    self._on_response(state, req, resp)
+                f.write(json.dumps(resp, separators=(",", ":"))
+                        .encode() + b"\n")
+                f.flush()
+        except (OSError, ValueError):
+            pass            # client went away mid-write
+        finally:
+            try:
+                f.close()
+                conn.close()
+            except OSError:
+                pass
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass    # stop() already swept it
+            self._conn_closed(state)
+
+    def stop(self) -> None:
+        self._running = False
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        # snapshot under _lock: handler threads may still be mutating
+        # the registry (an accept racing the _running flip) while
+        # shutdown walks it
+        with self._lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def serve_forever(self) -> None:
+        """start() + block until KeyboardInterrupt (the CLI path)."""
+        self.start()
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            log.info("[%s] shutting down", self.WIRE_NAME)
+        finally:
+            self.stop()
+
+    def __enter__(self) -> "WireServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
