@@ -38,11 +38,16 @@ fi
 # module — device.py, which wraps EVERY engine/driver device program
 # — and the ISSUE 14 fleet-telemetry modules — ship.py, whose
 # offer() sits on the driver/serve hot paths, and hub.py, the
-# collector every process reports into — are part of the obs/
-# package and inherit the rule), and the multi-tenant serving plane
-# (ISSUE 8 — a silenced retrace or host-sync hazard there stalls
-# EVERY tenant at once; since ISSUE 14 serve/wire.py is the service
-# kernel EVERY wire-speaking plane runs on) get
+# collector every process reports into, and the ISSUE 15
+# fault-injection registry obs/faults.py, whose fire() sits
+# permanently inside the wire/checkpoint/store/pool seams — are
+# part of the obs/ package and inherit the rule), and the
+# multi-tenant serving plane (ISSUE 8 — a silenced retrace or
+# host-sync hazard there stalls EVERY tenant at once; since
+# ISSUE 14 serve/wire.py is the service kernel EVERY wire-speaking
+# plane runs on, and since ISSUE 15 serve/durable.py is the
+# write-ahead checkpoint plane the zero-committed-loss contract
+# rests on) get
 # no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys

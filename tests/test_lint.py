@@ -641,11 +641,15 @@ def test_package_suppression_free(package):
     process reports into — live in the same package and inherit the
     rule)
     — a silenced hazard there would tax or skew the measurements it
-    exists to make; serve/ multiplexes every tenant onto three shared
+    exists to make, and the ISSUE 15 fault-injection registry
+    faults.py sits permanently inside the wire/checkpoint/store/pool
+    seams; serve/ multiplexes every tenant onto three shared
     compiled programs (ISSUE 8) — a silenced retrace or host-sync
-    hazard there stalls ALL sessions at once, and since ISSUE 14 its
+    hazard there stalls ALL sessions at once, since ISSUE 14 its
     wire.py service kernel carries EVERY wire-speaking plane (session
-    server + telemetry hub).  lint.sh enforces the same in the
+    server + telemetry hub), and since ISSUE 15 its durable.py
+    write-ahead checkpoint plane carries the zero-committed-loss
+    contract.  lint.sh enforces the same in the
     pre-commit gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",

@@ -49,6 +49,14 @@ DEFAULTS: Dict[str, Any] = {
     # concurrent instances sharing one directory exchange results.
     # None = <work_dir>/ut.temp/store; the literal 'off' disables
     "store-dir": None,
+    # fsync every store segment append (docs/STORE.md "Durability"):
+    # the O_APPEND protocol already survives process SIGKILL via the
+    # page cache; this knob additionally survives power loss / kernel
+    # panic at the cost of one fsync per recorded build.  Layered
+    # under the UT_STORE_FSYNC env var; off by default — a recorded
+    # build is re-measurable, so most deployments prefer the append
+    # to stay off the critical path
+    "store-fsync": False,
     # warm-start a fresh tune from the store's recorded rows for the
     # same (space, program): preload best-so-far + dedup history +
     # surrogate training set before the first acquisition
@@ -103,6 +111,16 @@ DEFAULTS: Dict[str, Any] = {
     # build.  None = ut.serve/store under the server's cwd; 'off'
     # disables the memo
     "serve-store-dir": None,
+    # crash-safe serving (docs/SERVING.md "Durability & failover"):
+    # a directory (or 'on' for <store-dir>/checkpoints) turns on the
+    # write-ahead session checkpoint plane — every committed session
+    # transition is journaled before its reply, `ut serve --durable`
+    # recovers all live sessions on restart, and resuming clients
+    # re-attach losslessly.  None/'off' disables
+    "serve-durable": None,
+    # fsync each checkpoint append (power-loss durability; SIGKILL
+    # durability needs no fsync — same tradeoff as store-fsync)
+    "serve-durable-fsync": False,
 }
 
 settings: Dict[str, Any] = dict(DEFAULTS)

@@ -26,7 +26,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import obs
-from ..obs import sidecar
+from ..obs import faults, sidecar
 from .measure import _preexec, kill_process_group
 
 PROTOCOL_FILES = ("ut.params.json",)   # copied (not symlinked) per sandbox
@@ -248,6 +248,7 @@ class WorkerPool:
 
     def _reap(self, slot: _Slot, *, killed: bool) -> Tuple[Any, Optional[
             float], float, Dict[str, Any]]:
+        faults.fire("pool.reap")
         dur = time.time() - slot.t0
         self.busy_s += dur
         rc = slot.proc.returncode

@@ -27,7 +27,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, Optional
 
-from . import device, flight, journal, quality, ship
+from . import device, faults, flight, journal, quality, ship
 from .core import (DEFAULT_CAPACITY, complete_span, device_span,
                    disable, emit_at, enable, enabled, event,
                    new_span_id, now, reset, snapshot, span,
@@ -48,7 +48,7 @@ __all__ = [
     "maybe_enable_from_env", "finish", "start_flight_recorder",
     "install_exit_flush", "instrument_device_fn", "DEFAULT_CAPACITY",
     "journal", "quality", "start_journal", "stop_journal",
-    "maybe_journal_from_env", "device", "flight", "ship",
+    "maybe_journal_from_env", "device", "faults", "flight", "ship",
 ]
 
 

@@ -484,3 +484,21 @@ class SessionQuality:
                 "new_bests": self.new_bests,
                 "tells_since_best": self.tells_since_best,
                 "fail_rate": fr}
+
+    def state(self) -> list:
+        """JSON-clean snapshot for the serve checkpoint plane
+        (ISSUE 15): counters + the failure ring as 0/1 bits, so a
+        restored session's health verdict replays exactly."""
+        return [self.tells, self.new_bests, self.tells_since_best,
+                [1 if b else 0 for b in self._ok]]
+
+    def restore(self, state) -> None:
+        try:
+            tells, new_bests, since, ring = state
+            self.tells = int(tells)
+            self.new_bests = int(new_bests)
+            self.tells_since_best = int(since)
+            self._ok = deque((bool(b) for b in ring),
+                             maxlen=self.FAIL_WINDOW)
+        except (TypeError, ValueError):
+            pass        # a malformed record degrades health, not restore

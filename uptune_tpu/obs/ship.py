@@ -40,22 +40,39 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..utils.net import reject_self_connect
 from . import core, journal, metrics, quality
 
 __all__ = ["TelemetryShipper", "start", "stop", "active",
-           "maybe_ship_from_env", "source_label", "DEFAULT_INTERVAL",
-           "DEFAULT_QUEUE_MAX", "DEFAULT_BATCH_MAX"]
+           "maybe_ship_from_env", "source_label", "backoff_jitter",
+           "DEFAULT_INTERVAL", "DEFAULT_QUEUE_MAX",
+           "DEFAULT_BATCH_MAX"]
 
 DEFAULT_INTERVAL = 1.0
 DEFAULT_QUEUE_MAX = 4096        # queued rows (each ~hundreds of bytes)
 DEFAULT_BATCH_MAX = 512         # rows per ship request (ack unit)
 BACKOFF_BASE = 0.25
 BACKOFF_MAX = 5.0
+
+# reconnect jitter (ISSUE 15 satellite): after a hub restart a whole
+# fleet used to reconnect in LOCKSTEP on the same 0.25s..5s schedule —
+# a thundering herd on the hub accept loop every backoff tick.  Each
+# process waits a uniformly drawn fraction [1/2, 1] of its current
+# backoff instead; the exponential GROWTH stays deterministic, only
+# the wait is spread.  Per-process RNG: the herd decorrelates even
+# when every process starts from the same fork image
+_JITTER_RNG = random.Random(os.urandom(8))
+
+
+def backoff_jitter(backoff: float) -> float:
+    """The jittered wait for one reconnect backoff step."""
+    return float(backoff) * (0.5 + 0.5 * _JITTER_RNG.random())
 
 
 def source_label(src: Dict[str, Any]) -> str:
@@ -184,8 +201,10 @@ class TelemetryShipper:
                 self._close()
                 if not stopping:
                     # reconnect-with-backoff: sleep here (not the hub's
-                    # problem), capped, reset on the next success
-                    if self._stop.wait(backoff):
+                    # problem), capped, reset on the next success —
+                    # jittered so a restarted hub's whole fleet does
+                    # not reconnect in lockstep (backoff_jitter)
+                    if self._stop.wait(backoff_jitter(backoff)):
                         stopping = True
                     backoff = min(self.backoff_max, backoff * 2)
             if stopping:
@@ -259,6 +278,7 @@ class TelemetryShipper:
             return self._file
         s = socket.create_connection(self.addr,
                                      timeout=self.connect_timeout)
+        reject_self_connect(s, f"{self.addr[0]}:{self.addr[1]}")
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         f = s.makefile("rwb")
         # hello announces the source (and survives hub restarts: every

@@ -34,6 +34,11 @@ _LAZY = {
     "SessionClient": ("uptune_tpu.serve.client", "SessionClient"),
     "SessionHandle": ("uptune_tpu.serve.client", "SessionHandle"),
     "ServeError": ("uptune_tpu.serve.client", "ServeError"),
+    "ConnectionLostError": ("uptune_tpu.serve.client",
+                            "ConnectionLostError"),
+    "CheckpointLog": ("uptune_tpu.serve.durable", "CheckpointLog"),
+    "SessionRestoredError": ("uptune_tpu.serve.session",
+                             "SessionRestoredError"),
     "Trial": ("uptune_tpu.serve.client", "Trial"),
     "connect": ("uptune_tpu.serve.client", "connect"),
     "SessionGroup": ("uptune_tpu.serve.group", "SessionGroup"),

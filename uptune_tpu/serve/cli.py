@@ -46,6 +46,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "the cwd)")
     p.add_argument("--work-dir", default=None,
                    help="base dir for the default store location")
+    p.add_argument("--durable", nargs="?", const="on", default=None,
+                   metavar="DIR",
+                   help="crash-safe serving (docs/SERVING.md "
+                        "'Durability & failover'): journal every "
+                        "committed session transition to per-session "
+                        "checkpoint segments (DIR, or "
+                        "<store-dir>/checkpoints when omitted) and "
+                        "recover all live sessions on startup — "
+                        "SIGKILL loses zero committed tells.  'off' "
+                        "disables (default: ut.config serve-durable)")
+    p.add_argument("--durable-fsync", action="store_true",
+                   default=None,
+                   help="fsync each checkpoint append: committed "
+                        "tells additionally survive power loss "
+                        "(SIGKILL durability needs no fsync; default: "
+                        "ut.config serve-durable-fsync)")
+    p.add_argument("--orphan-ttl", type=float, default=None,
+                   metavar="SECONDS",
+                   help="grace a disconnected durable tenant gets "
+                        "before its slot is swept (default 900); "
+                        "resuming clients re-attach inside it")
     p.add_argument("--trace", default=None, metavar="OUT.json",
                    help="observability export written at shutdown "
                         "(docs/OBSERVABILITY.md); 'off' disables. "
@@ -92,10 +113,13 @@ def resolve_config(args: argparse.Namespace) -> dict:
     for flag, key in (("host", "serve-host"), ("port", "serve-port"),
                       ("slots", "serve-slots"),
                       ("max_sessions", "serve-max-sessions"),
-                      ("store_dir", "serve-store-dir")):
+                      ("store_dir", "serve-store-dir"),
+                      ("durable", "serve-durable"),
+                      ("durable_fsync", "serve-durable-fsync")):
         v = getattr(args, flag)
         out[flag] = settings[key] if v is None else v
     out["work_dir"] = args.work_dir
+    out["orphan_ttl"] = args.orphan_ttl
     return out
 
 
@@ -161,6 +185,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # journal without trace: SIGINT/SIGTERM must still flush the
         # buffered journal tail (and unwind into the finally below)
         obs.install_exit_flush(None)
+
+    # UT_FAULTS (obs/faults.py): deterministic crash/delay/error
+    # schedules for failover tests and `bench.py --failover` — a
+    # production server never sets this; log loudly when armed
+    n_faults = obs.faults.maybe_arm_from_env()
+    if n_faults:
+        log.warning("[ut-serve] %d fault-injection rule(s) ARMED via "
+                    "UT_FAULTS: %s", n_faults, obs.faults.schedules())
 
     from .server import SessionServer
     srv = SessionServer(**resolve_config(args))

@@ -17,6 +17,27 @@ number of sessions (requests are synchronous per connection and
 serialized by an internal lock — open several clients for parallel
 request streams).  Spaces are sent as JSON param records; a library
 ``Space`` is serialized via ``exec.space_io.records_from_space``.
+
+Auto-resume (ISSUE 15, docs/SERVING.md "Durability & failover"):
+``connect(addr, auto_resume=True)`` makes the connection crash-safe
+against both transient network failures and full server restarts.
+Every op gets a bounded socket timeout; on a connection failure the
+client reconnects with exponential backoff plus jitter, re-attaches
+each of its sessions by durable id, and replays only the idempotent
+frontier:
+
+* ``open`` carries a client-minted session id, so a retried open
+  whose ack was lost re-attaches instead of leaking a session;
+* a retried ``ask`` carries ``reissue``, so tickets the lost reply
+  already handed out are re-offered rather than stranded;
+* ``tell`` carries the ticket's epoch id and the session's
+  incarnation token, so a duplicate replay after an
+  acked-but-unobserved reply is detected and squashed server-side.
+
+The one failure auto-resume surfaces instead of hiding: a ticket
+from an in-flight epoch a server CRASH destroyed (the bounded-loss
+contract) fails with a "restored" ServeError — re-``ask()`` and
+retry with the fresh tickets.
 """
 from __future__ import annotations
 
@@ -24,19 +45,30 @@ import json
 import socket
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Union
 
 from .. import obs
+from ..obs.ship import backoff_jitter
+from ..utils.net import reject_self_connect
 
 
 class ServeError(RuntimeError):
     """The server answered ok=False."""
 
 
+class ConnectionLostError(ServeError):
+    """The connection died mid-exchange (closed, timed out, or
+    desynced) — the retryable class auto-resume acts on."""
+
+
 class Trial(NamedTuple):
-    """One proposed trial: measure `config`, tell `ticket`."""
+    """One proposed trial: measure `config`, tell `ticket`.  `epoch`
+    is the ticket's session-version tag, echoed on tell so resume
+    replays are idempotent."""
     ticket: int
     config: Dict[str, Any]
+    epoch: int = 0
 
 
 def _parse_addr(addr: Union[str, tuple, None]) -> tuple:
@@ -52,26 +84,76 @@ def _parse_addr(addr: Union[str, tuple, None]) -> tuple:
 
 
 def connect(addr: Union[str, tuple, None] = None,
-            timeout: float = 60.0) -> "SessionClient":
+            timeout: float = 60.0, **kw: Any) -> "SessionClient":
     """Open a client connection (`addr` = "host:port", a (host, port)
-    pair, or None for the configured serve-host/serve-port)."""
-    return SessionClient(*_parse_addr(addr), timeout=timeout)
+    pair, or None for the configured serve-host/serve-port).  Keyword
+    arguments (`auto_resume`, `op_timeout`, `max_retries`, ...) pass
+    through to SessionClient."""
+    host, port = _parse_addr(addr)
+    return SessionClient(host, port, timeout=timeout, **kw)
 
 
 class SessionClient:
-    def __init__(self, host: str, port: int, timeout: float = 60.0):
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 *, op_timeout: Optional[float] = None,
+                 auto_resume: bool = False, max_retries: int = 8,
+                 backoff_base: float = 0.25, backoff_max: float = 5.0):
         self.host, self.port = host, int(port)
-        self._sock = socket.create_connection((host, self.port),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._f = self._sock.makefile("rwb")
+        self.connect_timeout = float(timeout)
+        # bounded per-op timeout: defaults to the connect timeout so
+        # no request can hang forever (the pre-ISSUE-15 behavior kept
+        # the connect timeout on the socket; this makes it explicit
+        # and independently tunable)
+        self.op_timeout = float(op_timeout if op_timeout is not None
+                                else timeout)
+        self.auto_resume = bool(auto_resume)
+        self.max_retries = int(max_retries)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
         self._lock = threading.Lock()
+        # serializes the reconnect+reattach sequence across threads
+        # sharing this client (a separate lock: _reattach exchanges
+        # under _lock, so holding _lock across it would deadlock)
+        self._resume_lock = threading.Lock()
         self._broken = False
+        self._sock: Optional[socket.socket] = None
+        self._f = None
+        # durable session ids this client opened (or attached), in
+        # open order — re-attached after every reconnect so the new
+        # connection owns them server-side
+        self._resume_ids: List[str] = []
+        self.reconnects = 0
+        self._connect()
 
     # -- wire ----------------------------------------------------------
-    def request(self, op: str, **fields: Any) -> Dict[str, Any]:
-        """One synchronous request/response; raises ServeError on
-        ok=False.
+    def _connect(self) -> None:
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.connect_timeout)
+        reject_self_connect(s, f"{self.host}:{self.port}")
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(self.op_timeout)
+        self._sock = s
+        self._f = s.makefile("rwb")
+        self._broken = False
+
+    def _drop_conn(self) -> None:
+        try:
+            if self._f is not None:
+                self._f.close()
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._f = None
+        self._sock = None
+        self._broken = True
+
+    def _exchange(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """One synchronous request/response on the current connection;
+        raises ConnectionLostError when the exchange cannot complete
+        (and marks the connection broken: a died-mid-exchange reply
+        may still be in flight, and the NEXT request would silently
+        consume it as its own — the stream is desynced).
 
         Trace-context propagation (docs/OBSERVABILITY.md): when THIS
         process is tracing, the request carries a ``ctx`` span id and
@@ -80,21 +162,15 @@ class SessionClient:
         the same id as ``parent``, so `ut-trace merge` joins the two
         shards and decomposes client-observed latency into wire vs
         server time.  Untraced clients send no extra field."""
-        payload = {"op": op, **{k: v for k, v in fields.items()
-                                if v is not None}}
         sid = None
         t0 = 0.0
         if obs.enabled():
             sid = obs.new_span_id()
-            payload["ctx"] = {"span": sid}
+            payload = {**payload, "ctx": {"span": sid}}
             t0 = time.perf_counter()
         with self._lock:
-            # a request that died mid-exchange (socket timeout,
-            # KeyboardInterrupt out of readline) leaves its response
-            # in flight; the NEXT request would silently consume it
-            # as its own.  The connection is desynced — refuse it.
-            if self._broken:
-                raise ServeError(
+            if self._broken or self._f is None:
+                raise ConnectionLostError(
                     "connection desynced by an interrupted request; "
                     "reconnect")
             try:
@@ -103,21 +179,99 @@ class SessionClient:
                               .encode() + b"\n")
                 self._f.flush()
                 line = self._f.readline()
-            except BaseException:
+            except BaseException as e:
                 self._broken = True
+                if isinstance(e, (OSError, ValueError)):
+                    raise ConnectionLostError(
+                        f"request {payload.get('op')!r} died "
+                        f"mid-exchange: {e}") from e
                 raise
         if sid is not None:
             obs.complete_span("client.request", t0=t0,
                               dur=time.perf_counter() - t0,
-                              op=op, ctx=sid,
+                              op=payload.get("op"), ctx=sid,
                               server=f"{self.host}:{self.port}")
         if not line:
-            raise ServeError(f"server {self.host}:{self.port} closed "
-                             f"the connection")
-        resp = json.loads(line)
+            self._broken = True
+            raise ConnectionLostError(
+                f"server {self.host}:{self.port} closed the "
+                f"connection")
+        try:
+            resp = json.loads(line)
+        except ValueError as e:
+            # a server dying mid-reply flushes a PARTIAL line; the
+            # EOF readline returns it non-empty, so this is the same
+            # connection-loss case as the empty read — it must reach
+            # the resume machinery, not the caller
+            self._broken = True
+            raise ConnectionLostError(
+                f"truncated reply from {self.host}:{self.port}: {e}"
+            ) from e
         if not resp.get("ok"):
             raise ServeError(resp.get("error", "unknown server error"))
         return resp
+
+    def _reattach(self) -> None:
+        """Re-own this client's sessions on a fresh connection.  A
+        session the server no longer knows (closed, orphan-swept, or
+        unrecoverable) is PRUNED and the rest still attach — one dead
+        session must not fail unrelated handles' ops on every
+        reconnect, or leave a live sibling un-attached with its
+        server-side orphan clock running.  The dead session surfaces
+        naturally: its own handle's next op gets 'unknown session'.
+        Connection-level failures still raise (the retry loop's
+        business)."""
+        for sid in list(self._resume_ids):
+            try:
+                self._exchange({"op": "attach", "session": sid})
+            except ConnectionLostError:
+                raise
+            except ServeError:
+                self._forget(sid)
+
+    def request(self, op: str, **fields: Any) -> Dict[str, Any]:
+        """One synchronous request/response; raises ServeError on
+        ok=False.  With ``auto_resume``, connection failures
+        reconnect with exponential backoff+jitter, re-attach every
+        session this client owns, and replay the request with its
+        idempotency tags (a replayed ``ask`` adds ``reissue`` so
+        already-issued tickets are re-offered, never re-minted)."""
+        payload = {"op": op, **{k: v for k, v in fields.items()
+                                if v is not None}}
+        attempt = 0
+        backoff = self.backoff_base
+        while True:
+            try:
+                if self._broken or self._f is None:
+                    if not self.auto_resume:
+                        raise ConnectionLostError(
+                            "connection desynced by an interrupted "
+                            "request; reconnect")
+                    # one thread reconnects; peers that also observed
+                    # the break queue here and RE-CHECK — without the
+                    # serialization, each thread's _drop_conn would
+                    # keep closing the connection a peer just rebuilt
+                    # and mutual interference could burn max_retries
+                    # against a perfectly healthy server
+                    with self._resume_lock:
+                        if self._broken or self._f is None:
+                            self._drop_conn()
+                            self._connect()
+                            self.reconnects += 1
+                            self._reattach()
+                    if payload.get("op") == "ask":
+                        payload["reissue"] = True
+                return self._exchange(payload)
+            except (ConnectionLostError, OSError) as e:
+                attempt += 1
+                self._broken = True
+                if not self.auto_resume or attempt > self.max_retries:
+                    raise
+                # jittered exponential backoff (the shipper's rule:
+                # a fleet of resuming clients must not stampede the
+                # restarted server in lockstep)
+                time.sleep(backoff_jitter(backoff))
+                backoff = min(self.backoff_max, backoff * 2)
 
     # -- surface -------------------------------------------------------
     def ping(self) -> Dict[str, Any]:
@@ -151,24 +305,41 @@ class SessionClient:
         list of JSON param records; `program` is the tenant-declared
         token naming WHAT is being measured — sessions naming the same
         program over the same space share the server's cross-tenant
-        result memo."""
+        result memo.  Under ``auto_resume`` the session id is minted
+        client-side, so a retried open re-attaches instead of leaking
+        a second session."""
         if not isinstance(space, (list, tuple)):
             from ..exec.space_io import records_from_space
             space = records_from_space(space)
+        sid = uuid.uuid4().hex[:16] if self.auto_resume else None
         resp = self.request(
             "open", space=list(space), seed=int(seed),
             program=str(program), sense=sense,
             arms=list(arms) if arms else None,
             history_capacity=int(history_capacity),
-            store="on" if store else "off")
+            store="on" if store else "off", session=sid)
+        if self.auto_resume:
+            self._resume_ids.append(resp["session"])
         return SessionHandle(self, resp["session"], resp)
 
-    def close(self) -> None:
+    def attach_session(self, session_id: str) -> "SessionHandle":
+        """Re-attach to a durable session by id (e.g. after this
+        CLIENT process restarted — the server-restart case is handled
+        transparently by auto_resume)."""
+        resp = self.request("attach", session=str(session_id))
+        if self.auto_resume and resp["session"] not in self._resume_ids:
+            self._resume_ids.append(resp["session"])
+        return SessionHandle(self, resp["session"], resp)
+
+    def _forget(self, session_id: str) -> None:
         try:
-            self._f.close()
-            self._sock.close()
-        except OSError:
+            self._resume_ids.remove(session_id)
+        except ValueError:
             pass
+
+    def close(self) -> None:
+        self._resume_ids.clear()
+        self._drop_conn()
 
     def __enter__(self) -> "SessionClient":
         return self
@@ -178,37 +349,55 @@ class SessionClient:
 
 
 class SessionHandle:
-    """One session on one client: ask / tell / best / close."""
+    """One session on one client: ask / tell / best / close.  Tracks
+    the per-ticket epoch tags and the session's incarnation token so
+    every tell carries the resume protocol's idempotency fields."""
 
     def __init__(self, client: SessionClient, session_id: str,
                  info: Optional[dict] = None):
         self.client = client
         self.id = session_id
         self.info = dict(info or {})
-        self.version = 0
+        self.version = int(self.info.get("version", 0))
+        self.incarn = self.info.get("incarn")
         self.store_served = 0
+        self._ticket_epoch: Dict[int, int] = {}
 
     def ask(self, n: int = 1) -> List[Trial]:
         resp = self.client.request("ask", session=self.id, n=int(n))
         self.version = resp.get("version", self.version)
+        self.incarn = resp.get("incarn", self.incarn)
         self.store_served = resp.get("store_served", self.store_served)
-        return [Trial(t["ticket"], t["config"])
-                for t in resp["trials"]]
+        out = [Trial(t["ticket"], t["config"],
+                     int(t.get("epoch", self.version)))
+               for t in resp["trials"]]
+        for t in out:
+            self._ticket_epoch[t.ticket] = t.epoch
+        return out
+
+    def _after_tell(self, resp: Dict[str, Any], tickets) -> None:
+        self.version = resp.get("version", self.version)
+        for t in tickets:
+            self._ticket_epoch.pop(t, None)
 
     def tell(self, ticket: int, qor: Optional[float],
              dur: float = 0.0) -> Dict[str, Any]:
-        resp = self.client.request("tell", session=self.id,
-                                   ticket=int(ticket), qor=qor,
-                                   dur=dur or None)
-        self.version = resp.get("version", self.version)
+        resp = self.client.request(
+            "tell", session=self.id, ticket=int(ticket), qor=qor,
+            dur=dur or None,
+            epoch=self._ticket_epoch.get(int(ticket)),
+            incarn=self.incarn)
+        self._after_tell(resp, [int(ticket)])
         return resp
 
     def tell_many(self, results) -> Dict[str, Any]:
         """Report many (ticket, qor) pairs in ONE round trip."""
-        resp = self.client.request(
-            "tell", session=self.id,
-            results=[{"ticket": int(t), "qor": q} for t, q in results])
-        self.version = resp.get("version", self.version)
+        rows = [{"ticket": int(t), "qor": q,
+                 "epoch": self._ticket_epoch.get(int(t))}
+                for t, q in results]
+        resp = self.client.request("tell", session=self.id,
+                                   results=rows, incarn=self.incarn)
+        self._after_tell(resp, [r["ticket"] for r in rows])
         return resp
 
     def best(self) -> Dict[str, Any]:
@@ -220,6 +409,7 @@ class SessionHandle:
                                    **thresholds)["health"]
 
     def close(self) -> None:
+        self.client._forget(self.id)
         try:
             self.client.request("close", session=self.id)
         except (ServeError, OSError):

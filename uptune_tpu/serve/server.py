@@ -35,19 +35,26 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import obs
 from ..api.session import settings
 from ..exec.space_io import space_from_params
 from ..store.store import ResultStore
+from .durable import CheckpointLog, decode_raw, default_checkpoint_dir
 from .group import SessionGroup, group_key
 from .session import Session, StaleTicketError
 from .wire import RequestError, WireServer  # noqa: F401  (re-export)
 
 log = logging.getLogger("uptune_tpu")
+
+# a client-proposed durable session id becomes a checkpoint FILENAME:
+# constrain it to the uuid-hex shape the server mints itself
+_SID_OK = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
 
 
 def _resolve(value, key):
@@ -63,12 +70,21 @@ class SessionServer(WireServer):
 
     WIRE_NAME = "ut-serve"
 
+    # grace a disconnected durable tenant gets before its slot is
+    # swept (seconds): a resuming client re-attaches well inside it,
+    # a truly dead one stops leaking its slot + admission unit —
+    # lazily enforced on open/attach/stats (no reaper thread)
+    ORPHAN_TTL = 900.0
+
     def __init__(self, host: Optional[str] = None,
                  port: Optional[int] = None,
                  slots: Optional[int] = None,
                  max_sessions: Optional[int] = None,
                  store_dir: Optional[str] = None,
-                 work_dir: Optional[str] = None):
+                 work_dir: Optional[str] = None,
+                 durable: Optional[str] = None,
+                 durable_fsync: Optional[bool] = None,
+                 orphan_ttl: Optional[float] = None):
         super().__init__(str(_resolve(host, "serve-host")),
                          int(_resolve(port, "serve-port")))
         self.slots = int(_resolve(slots, "serve-slots"))
@@ -94,6 +110,31 @@ class SessionServer(WireServer):
         # bounded per thread, so long-lived servers don't grow
         if not obs.enabled():
             obs.enable()
+        # -- durable sessions (ISSUE 15, docs/SERVING.md) --------------
+        dv = _resolve(durable, "serve-durable")
+        if dv is not None and str(dv).lower() in ("off", "none", "0",
+                                                  "false"):
+            dv = None
+        self.ckpt: Optional[CheckpointLog] = None
+        self.orphan_ttl = float(orphan_ttl if orphan_ttl is not None
+                                else self.ORPHAN_TTL)
+        self._orphans: Dict[str, float] = {}   # sid -> disconnect time
+        # sid -> owning-connection token (id of its owned-set): a DEAD
+        # connection may only orphan-stamp sessions it still owns, so
+        # a lingering old connection's demise cannot re-orphan a
+        # session its client already re-attached elsewhere
+        self._owners: Dict[str, int] = {}
+        self.recovered = 0
+        self.recovery_s = 0.0
+        if dv is not None:
+            cdir = (default_checkpoint_dir(self.store_dir,
+                                           self.work_dir)
+                    if str(dv).lower() in ("on", "true", "1")
+                    else os.path.abspath(str(dv)))
+            self.ckpt = CheckpointLog(
+                cdir, fsync=bool(_resolve(durable_fsync,
+                                          "serve-durable-fsync")))
+            self._recover()
 
     # -- registry ------------------------------------------------------
     def _store_for(self, space, program: str) -> Optional[ResultStore]:
@@ -124,7 +165,8 @@ class SessionServer(WireServer):
         return st
 
     def _join_group(self, space, arms, sense: str,
-                    history_capacity: int, seed: int, store) -> Session:
+                    history_capacity: int, seed: int, store,
+                    session_id: Optional[str] = None) -> Session:
         """Join a free slot in an existing group for this key, or
         construct a new group and join it.  Group construction traces
         and compiles three programs (seconds) — it runs under a PER-KEY
@@ -140,7 +182,8 @@ class SessionServer(WireServer):
                          if g.n_free]
             for g in frees:
                 try:
-                    return g.join(seed, store=store)
+                    return g.join(seed, store=store,
+                                  session_id=session_id)
                 except IndexError:
                     continue    # lost the last slot to a racing join
             with klock:
@@ -154,11 +197,101 @@ class SessionServer(WireServer):
                     self._groups[key].append(g)
                 obs.count("serve.groups_created")
 
+    # -- crash recovery (serve/durable.py, ISSUE 15) -------------------
+    def _recover(self) -> None:
+        """Restore every live checkpointed session (reaping closed
+        ones) before the listener binds: a resuming client's attach
+        can never observe a half-recovered registry.  Each restore
+        replays the commit stream through the group's compiled
+        propose/commit programs — signatures with more survivors than
+        one group's slots simply allocate further groups, exactly as
+        live opens do."""
+        t0 = time.perf_counter()
+        for sid, bundle in self.ckpt.scan():
+            if bundle["closed"] or bundle["open"] is None:
+                self.ckpt.reap(sid)
+                continue
+            try:
+                self._restore_session(sid, bundle)
+                self.recovered += 1
+            except Exception:
+                # one corrupt/unplaceable segment must not take down
+                # every other tenant's recovery; the segment is kept
+                # on disk for post-mortem
+                log.exception("[%s] failed to restore session %s",
+                              self.WIRE_NAME, sid)
+                obs.count("serve.recover_errors")
+        self.recovery_s = round(time.perf_counter() - t0, 3)
+        if self.recovered:
+            log.info("[%s] recovered %d session(s) in %.2fs from %s",
+                     self.WIRE_NAME, self.recovered, self.recovery_s,
+                     self.ckpt.root)
+        obs.gauge("serve.recovered", self.recovered)
+
+    def _restore_session(self, sid: str, bundle: dict) -> None:
+        o = bundle["open"]
+        space = space_from_params(o["space"])
+        store = (self._store_for(space, str(o.get("program", "")))
+                 if o.get("store") else None)
+        with self._lock:
+            if self._admitted >= self.max_sessions:
+                raise RequestError(
+                    f"server full ({self.max_sessions} sessions)")
+            self._admitted += 1
+        try:
+            sess = self._join_group(
+                space, o.get("arms"), str(o.get("sense", "min")),
+                int(o.get("hist", 1 << 10)), int(o.get("seed", 0)),
+                store, session_id=sid)
+            for rec in bundle["commits"]:
+                sess._replay_commit(decode_raw(rec["raw"]))
+            if bundle["commits"]:
+                sess._restore_host(bundle["commits"][-1],
+                                   uuid.uuid4().hex[:8])
+            else:
+                sess._mark_restored(uuid.uuid4().hex[:8])
+            sess.durable = self.ckpt
+            with self._lock:
+                self._sessions[sess.id] = sess
+                # restored tenants start disconnected: the orphan
+                # clock runs until their client re-attaches
+                self._orphans[sess.id] = time.time()
+                obs.gauge("serve.sessions.active", self.n_sessions)
+        except BaseException:
+            with self._lock:
+                self._admitted -= 1
+            raise
+
+    def _sweep_orphans(self) -> None:
+        """Close durable sessions whose client disconnected more than
+        orphan_ttl ago (lazily, from the open/attach/stats paths):
+        resume stays lossless inside the grace window, and a dead
+        tenant stops pinning its slot + admission unit forever."""
+        if self.ckpt is None or not self._orphans:
+            return
+        now = time.time()
+        with self._lock:
+            expired = [sid for sid, t in self._orphans.items()
+                       if now - t > self.orphan_ttl]
+            for sid in expired:
+                self._orphans.pop(sid, None)
+        for sid in expired:
+            self.handle({"op": "close", "session": sid})
+            obs.count("serve.orphans_reaped")
+
     def _session(self, req: dict) -> Session:
         sid = req.get("session")
         sess = self._sessions.get(sid)
         if sess is None:
             raise RequestError(f"unknown session {sid!r}")
+        # activity cancels orphanhood: a recovered session driven
+        # without an explicit attach (in-process callers, a client
+        # whose attach was lost) must not be swept mid-drive.  One
+        # truthy check on the hot path; the lock only when a clock is
+        # actually running
+        if self._orphans and self.ckpt is not None:
+            with self._lock:
+                self._orphans.pop(sess.id, None)
         return sess
 
     @property
@@ -195,6 +328,25 @@ class SessionServer(WireServer):
         program = str(req.get("program", ""))
         use_store = str(req.get("store", "on")).lower() not in (
             "off", "false", "0")
+        # a resuming client may propose its own durable session id so
+        # a retried open (reply lost mid-exchange) re-attaches instead
+        # of leaking a second session.  The id becomes a checkpoint
+        # filename: constrain its shape
+        sid = req.get("session")
+        if sid is not None:
+            if not isinstance(sid, str) or not _SID_OK.match(sid):
+                raise RequestError(
+                    "session id must match [A-Za-z0-9_-]{1,64}")
+            with self._lock:
+                existing = self._sessions.get(sid)
+                if existing is not None:
+                    # idempotent re-open = an attach: the resuming
+                    # client is live again, so its orphan clock (a
+                    # lost-reply disconnect may have started it) stops
+                    self._orphans.pop(sid, None)
+            if existing is not None:
+                return self._attach_payload(existing)
+        self._sweep_orphans()
         # admission is a reserve-then-join two-step so the (possibly
         # compiling) join runs outside the registry lock without
         # letting racing opens overshoot max_sessions
@@ -208,19 +360,58 @@ class SessionServer(WireServer):
                      else None)
             try:
                 sess = self._join_group(space, arms, sense, hist,
-                                        seed, store)
+                                        seed, store, session_id=sid)
             except ValueError as e:     # e.g. no arm supports space
                 raise RequestError(str(e))
+            if self.ckpt is not None:
+                # the open record is durable BEFORE the reply: a
+                # session a client ever heard about is recoverable
+                self.ckpt.append(sess.id, {
+                    "ev": "open", "t": round(time.time(), 3),
+                    "space": records, "seed": seed,
+                    "program": program, "sense": sense, "arms": arms,
+                    "hist": hist, "store": store is not None})
+                sess.durable = self.ckpt
             with self._lock:
-                self._sessions[sess.id] = sess
+                cur = self._sessions.get(sess.id)
+                if cur is None:
+                    self._sessions[sess.id] = sess
                 obs.gauge("serve.sessions.active", self.n_sessions)
+            if cur is not None:
+                # lost an id race with a concurrent open/attach: fold
+                # into the winner (the loser's durable mark is cleared
+                # first so closing it cannot reap the winner's segment)
+                sess.durable = None
+                sess.close()
+                with self._lock:
+                    self._admitted -= 1
+                    self._orphans.pop(cur.id, None)
+                return self._attach_payload(cur)
         except BaseException:
             with self._lock:
                 self._admitted -= 1
             raise
+        return self._attach_payload(sess)
+
+    def _attach_payload(self, sess: Session) -> dict:
         grp = sess.group
         return {"session": sess.id, "slots": grp.n_slots,
-                "batch": grp.batch, "store": store is not None}
+                "batch": grp.batch, "store": sess.store is not None,
+                "version": sess.version, "incarn": sess.incarn,
+                "durable": self.ckpt is not None}
+
+    def _op_attach(self, req: dict) -> dict:
+        """Re-attach a resuming client to its durable session id
+        (after a reconnect or a server restart): clears the orphan
+        clock, transfers connection ownership (via _on_response), and
+        returns the open-shaped payload including the session's
+        current version and incarnation token."""
+        self._sweep_orphans()
+        sess = self._session(req)
+        with self._lock:
+            self._orphans.pop(sess.id, None)
+        obs.count("serve.attaches")
+        return self._attach_payload(sess)
 
     def _op_ask(self, req: dict) -> dict:
         sess = self._session(req)
@@ -229,17 +420,32 @@ class SessionServer(WireServer):
         except (TypeError, ValueError) as e:
             raise RequestError(f"n must be an integer: {e}")
         t0 = time.perf_counter()
+        reissued = False
         try:
-            offers = sess.ask(n)
+            if req.get("reissue"):
+                # the resume path: an ask whose reply was lost already
+                # ticketed rows out — re-offer the outstanding tickets
+                # first so the epoch can settle (new rows only once
+                # nothing is outstanding)
+                offers = sess.outstanding()
+                reissued = bool(offers)
+                if not offers:
+                    offers = sess.ask(n)
+            else:
+                offers = sess.ask(n)
         except StaleTicketError as e:
             # a concurrent close between the registry fetch and the
             # ask is a routine client-side race, not a server fault
             raise RequestError(str(e))
         obs.observe("serve.ask_ms", (time.perf_counter() - t0) * 1e3)
-        return {"trials": [{"ticket": o.ticket, "config": o.config}
+        if reissued:
+            obs.count("serve.reissues")
+        return {"trials": [{"ticket": o.ticket, "config": o.config,
+                            "epoch": o.epoch}
                            for o in offers],
                 "version": sess.version,
-                "store_served": sess.store_served}
+                "store_served": sess.store_served,
+                "incarn": sess.incarn, "reissued": reissued}
 
     def _op_tell(self, req: dict) -> dict:
         """Single tell (`ticket` + `qor`) or a batch in one round trip
@@ -256,8 +462,9 @@ class SessionServer(WireServer):
         else:
             raise RequestError("tell needs 'ticket' or 'results'")
         t0 = time.perf_counter()
+        incarn = req.get("incarn")
         out: Dict[str, Any] = {"told": 0, "new_best": False,
-                               "committed": False}
+                               "committed": False, "duplicates": 0}
         # a batch applies element-wise: one bad/stale ticket must not
         # discard the progress of the others (they are already told
         # server-side — reporting ok=False would strand the epoch).
@@ -267,7 +474,8 @@ class SessionServer(WireServer):
         for r in batch:
             try:
                 one = sess.tell(int(r["ticket"]), r.get("qor"),
-                                float(r.get("dur", 0.0)))
+                                float(r.get("dur", 0.0)),
+                                epoch=r.get("epoch"), incarn=incarn)
             except StaleTicketError as e:
                 if not is_batch:
                     raise RequestError(str(e))
@@ -283,8 +491,14 @@ class SessionServer(WireServer):
                                           else None),
                                "error": f"bad tell payload: {e}"})
                 continue
-            out["told"] += 1
-            out["new_best"] = out["new_best"] or one["new_best"]
+            if one.get("duplicate"):
+                # a resume replay the session squashed: already
+                # applied (and, when committed, already durable) —
+                # not a fresh tell, but its epoch outcome still counts
+                out["duplicates"] += 1
+            else:
+                out["told"] += 1
+                out["new_best"] = out["new_best"] or one["new_best"]
             out["committed"] = out["committed"] or one["committed"]
             out["version"] = one["version"]
         if errors:
@@ -301,6 +515,8 @@ class SessionServer(WireServer):
         with self._lock:
             if self._sessions.pop(sess.id, None) is not None:
                 self._admitted -= 1
+            self._orphans.pop(sess.id, None)
+            self._owners.pop(sess.id, None)
             obs.gauge("serve.sessions.active", self.n_sessions)
         return {"closed": sess.id}
 
@@ -384,13 +600,23 @@ class SessionServer(WireServer):
             # other in the payload (scope hashes space sig + program)
             stores = {f"{k[1] or '<anon>'}@{s.scope[:10]}": s.stats()
                       for k, s in self._stores.items()}
-        return {"sessions": self.n_sessions, "groups": groups,
-                "stores": stores, "store_dir": self.store_dir}
+        out = {"sessions": self.n_sessions, "groups": groups,
+               "stores": stores, "store_dir": self.store_dir}
+        if self.ckpt is not None:
+            self._sweep_orphans()
+            with self._lock:
+                orphans = len(self._orphans)
+            out["durable"] = {**self.ckpt.stats(),
+                              "recovered": self.recovered,
+                              "recovery_s": self.recovery_s,
+                              "orphans": orphans,
+                              "orphan_ttl": self.orphan_ttl}
+        return out
 
-    _OPS = {"ping": _op_ping, "open": _op_open, "ask": _op_ask,
-            "tell": _op_tell, "best": _op_best, "close": _op_close,
-            "metrics": _op_metrics, "stats": _op_stats,
-            "health": _op_health}
+    _OPS = {"ping": _op_ping, "open": _op_open, "attach": _op_attach,
+            "ask": _op_ask, "tell": _op_tell, "best": _op_best,
+            "close": _op_close, "metrics": _op_metrics,
+            "stats": _op_stats, "health": _op_health}
 
     # -- wire hooks (serve/wire.py owns dispatch + the TCP loops) ------
     def _listen_banner(self) -> str:
@@ -408,14 +634,33 @@ class SessionServer(WireServer):
 
     def _on_response(self, owned: set, req: dict, resp: dict) -> None:
         if resp.get("ok") and isinstance(req, dict):
-            if req.get("op") == "open":
-                owned.add(resp["session"])
+            if req.get("op") in ("open", "attach"):
+                sid = resp["session"]
+                owned.add(sid)
+                with self._lock:
+                    # ownership MOVES to this connection, and a live
+                    # owner means no orphan clock is running
+                    self._owners[sid] = id(owned)
+                    self._orphans.pop(sid, None)
             elif req.get("op") == "close":
                 owned.discard(resp.get("closed"))
 
     def _conn_closed(self, owned: set) -> None:
         for sid in owned:   # best-effort: never raises
-            self.handle({"op": "close", "session": sid})
+            if self.ckpt is not None:
+                # durable sessions get an orphan grace window instead
+                # of the instant reap: a resuming client re-attaches
+                # (clearing the clock); a dead one is swept lazily
+                # after orphan_ttl.  Only the CURRENT owner may start
+                # the clock — a lingering old connection dying after
+                # its client re-attached elsewhere owns nothing here
+                with self._lock:
+                    if (sid in self._sessions
+                            and self._owners.get(sid) == id(owned)):
+                        self._orphans[sid] = time.time()
+                        self._owners.pop(sid, None)
+            else:
+                self.handle({"op": "close", "session": sid})
 
     def stop(self) -> None:
         super().stop()      # listener + live connections

@@ -22,6 +22,7 @@ per-session equality with it at matched seeds.
 """
 from __future__ import annotations
 
+import threading
 import uuid
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
@@ -30,6 +31,7 @@ import numpy as np
 from .. import obs
 from ..obs.quality import SessionQuality
 from ..store.keys import canon_config
+from .durable import encode_raw
 
 
 class StaleTicketError(KeyError):
@@ -37,13 +39,24 @@ class StaleTicketError(KeyError):
     an epoch that has been published over."""
 
 
+class SessionRestoredError(StaleTicketError):
+    """tell() against a ticket from an in-flight epoch that a server
+    crash destroyed: the session was restored from its checkpoint
+    (durable.py) and the epoch's ticket assignment cannot be trusted
+    across the restart — the client must re-ask (reissue) and retry
+    with the fresh tickets (docs/SERVING.md "Durability & failover")."""
+
+
 class TrialOffer(NamedTuple):
     """One ask() result row: measure `config` and tell `ticket` its
     QoR.  (`cached` offers carry a store-served QoR and need no tell —
     the serving counters report them; ask() returns only live
-    tickets.)"""
+    tickets.)  `epoch` is the session version the ticket was issued
+    against — carried back by resuming clients so a duplicate tell
+    replay is detected server-side (ISSUE 15)."""
     ticket: int
     config: Dict[str, Any]
+    epoch: int = 0
 
 
 class _Pending(object):
@@ -62,7 +75,7 @@ class _Pending(object):
 
     __slots__ = ("epoch", "version", "configs", "raw", "filled",
                  "next_row", "by_canon", "group_rows", "group_value",
-                 "tickets")
+                 "tickets", "told")
 
     def __init__(self, epoch, version: int, configs: List[dict]):
         self.epoch = epoch
@@ -76,6 +89,7 @@ class _Pending(object):
         self.group_rows: List[List[int]] = []
         self.group_value: List[Optional[float]] = []
         self.tickets: Dict[int, int] = {}       # ticket id -> dup-group
+        self.told: set = set()     # consumed tickets (duplicate squash)
 
     def fill(self, g: int, value: float) -> None:
         rows = self.group_rows[g]
@@ -114,6 +128,21 @@ class Session:
         self.store_served = 0       # rows auto-filled from the memo
         self.closed = False
         self._ticket_seq = 0
+        # durable checkpoint plane (ISSUE 15): the durable server sets
+        # `durable` to its CheckpointLog; _commit then buffers one
+        # record per published version, drained OUTSIDE the group lock
+        # but BEFORE the op's reply (ack-after-durable).  `incarn` is
+        # the restart-incarnation token: bumped by crash recovery so a
+        # pre-crash ticket can never be misapplied to a post-restore
+        # epoch that happens to reuse its (version, ticket id) pair
+        self.durable = None
+        self._ckpt_buf: List[Dict[str, Any]] = []
+        # serializes this session's checkpoint appends across handler
+        # threads, and carries the flushed-version watermark they
+        # synchronize on (see _drain_ckpt)
+        self._ckpt_lock = threading.Lock()
+        self._ckpt_flushed = 0
+        self.incarn = "0"
         # per-tenant search-quality accumulator (ISSUE 12): a few ints
         # + one bounded ring, updated at tell time under the group
         # lock, read by the server's {"op": "health"} op — always on
@@ -186,13 +215,69 @@ class Session:
         t = self._ticket_seq
         self._ticket_seq += 1
         p.tickets[t] = g
-        return TrialOffer(t, cfg)
+        return TrialOffer(t, cfg, p.version)
 
     def _commit(self) -> None:
         p = self.pending
         self.group.commit(self, p.epoch, p.raw)
         self.version += 1
         self.pending = None
+        if self.durable is not None:
+            # the v -> v+1 delta, buffered under the group lock (host
+            # dict + one B-float list) and appended to disk by
+            # _drain_ckpt outside it.  Incumbent/counters/quality are
+            # checkpointed verbatim: replay preserves values but not
+            # tell ORDER, and order is what breaks qor ties
+            self._ckpt_buf.append({
+                "ev": "commit", "v": self.version,
+                "raw": encode_raw(p.raw),
+                "best_cfg": self.best_config,
+                "best_qor": self.best_qor,
+                "asks": self.asks, "tells": self.tells,
+                "served": self.store_served,
+                "tseq": self._ticket_seq,
+                "q": self.quality.state()})
+
+    def _drain_ckpt(self) -> None:
+        """Flush buffered commit records to the checkpoint segment —
+        called outside the group lock (disk stays off the serving
+        path) but before the op's reply is written, so a committed:
+        true a client observed is always durable (the bounded-loss
+        contract bench.py --failover prices).
+
+        Two clients may drive one session from two handler threads,
+        so the drain is serialized per session (_ckpt_lock) and the
+        buffer swap happens INSIDE that lock: an op may only skip the
+        drain when the flushed watermark already covers every version
+        it could have published — never because a peer swapped the
+        buffer but has not finished appending (acking v+1 while v sat
+        un-appended in a stalled peer would leave a version gap scan()
+        rightly refuses to replay past)."""
+        if self.durable is None:
+            return
+        # racy fast path, safe by monotonicity: _ckpt_flushed only
+        # grows (under _ckpt_lock), and self.version was published
+        # before this op's commit record entered the buffer — a stale
+        # read can only send us through the lock unnecessarily
+        if self._ckpt_flushed >= self.version:
+            return
+        with self._ckpt_lock:
+            with self.group.lock:
+                recs, self._ckpt_buf = self._ckpt_buf, []
+            for i, r in enumerate(recs):
+                if not self.durable.append(self.id, r):
+                    # disk refused (ENOSPC/EIO — counted by the log):
+                    # requeue THIS record and the rest at the buffer
+                    # FRONT, order preserved, so a later drain retries
+                    # once the disk recovers.  The watermark must not
+                    # advance past a hole — recovery truncates at the
+                    # first version gap, so a skipped record would
+                    # silently void every later acked commit
+                    with self.group.lock:
+                        self._ckpt_buf[:0] = recs[i:]
+                    return
+                self._ckpt_flushed = max(self._ckpt_flushed,
+                                         int(r.get("v", 0)))
 
     # -- the ask/tell surface ------------------------------------------
     def ask(self, n: int = 1, max_auto: int = 4) -> List[TrialOffer]:
@@ -232,17 +317,82 @@ class Session:
                     continue
                 break   # remaining rows already ticketed: tell first
         obs.count("serve.asks", len(out))
+        # memo auto-commits above published versions: durable-ack them
+        # before this ask's reply, same rule as the tell path
+        self._drain_ckpt()
         return out
 
+    def outstanding(self) -> List[TrialOffer]:
+        """The current epoch's live (unanswered) tickets, re-offered
+        in issue order — the reconnect path: an ask whose reply was
+        lost already ticketed rows out, and re-asking must surface
+        THOSE tickets or the epoch can never settle (the client
+        resume protocol, docs/SERVING.md)."""
+        with self.group.lock:
+            p = self.pending
+            if p is None:
+                return []
+            return [TrialOffer(t, p.configs[p.group_rows[g][0]],
+                               p.version)
+                    for t, g in sorted(p.tickets.items())]
+
+    def _squash_duplicate(self, p: Optional[_Pending], ticket: int,
+                          epoch, incarn) -> Optional[Dict[str, Any]]:
+        """Duplicate-replay detection (called under the group lock
+        when `ticket` is not live).  A resuming client retries a tell
+        whose reply it never observed; the ticket's epoch id tells
+        the two cases apart: already-committed epoch -> squash as a
+        durable duplicate; already-told but uncommitted -> squash
+        without commit.  A ticket carrying a STALE incarnation token
+        from before a crash-restore is only squashable when its epoch
+        committed durably — otherwise it belongs to the lost
+        in-flight epoch and the client must re-ask."""
+        if epoch is None:
+            return None
+        try:
+            epoch = int(epoch)
+        except (TypeError, ValueError):
+            return None
+        if epoch < self.version:
+            # the ticket's epoch published durably (commit records are
+            # acked-before-reply): whatever incarnation issued it, its
+            # tell is inside that commit — a pure duplicate
+            return {"new_best": False, "committed": True,
+                    "version": self.version, "duplicate": True}
+        if incarn is not None and str(incarn) != self.incarn:
+            raise SessionRestoredError(
+                f"session {self.id} was restored after a crash; "
+                f"ticket {ticket} belongs to a lost in-flight epoch "
+                f"— re-ask (reissue) and retry")
+        if p is not None and epoch == p.version and ticket in p.told:
+            return {"new_best": False, "committed": False,
+                    "version": self.version, "duplicate": True}
+        return None
+
     def tell(self, ticket: int, qor: Optional[float],
-             dur: float = 0.0) -> Dict[str, Any]:
+             dur: float = 0.0, epoch=None, incarn=None
+             ) -> Dict[str, Any]:
         """Report a ticket's USER-oriented QoR (None/NaN/inf = build
         failure).  The tell completing the epoch publishes the next
-        snapshot version."""
+        snapshot version.  `epoch`/`incarn` are the resume protocol's
+        idempotence tags (the ticket's TrialOffer.epoch and the ask
+        reply's incarnation token): a duplicate replay after an
+        acked-but-unobserved reply is detected and squashed instead
+        of raising or double-applying."""
         with self.group.lock:
             self._check_open()
             p = self.pending
-            if p is None or ticket not in p.tickets:
+            # a ticket carrying a stale incarnation token must NEVER
+            # apply, even if its id coincides with a live ticket (the
+            # restored id space is offset — _mark_restored — so this
+            # is a belt, not the wall)
+            stale_inc = (incarn is not None
+                         and str(incarn) != self.incarn)
+            if p is None or ticket not in p.tickets or stale_inc:
+                dup = self._squash_duplicate(p, ticket, epoch, incarn)
+                if dup is not None:
+                    obs.count("serve.dup_tells")
+                    return dup
                 raise StaleTicketError(
                     f"ticket {ticket} is unknown, already told, or "
                     f"from a published-over epoch (session "
@@ -252,6 +402,7 @@ class Session:
             # and strand the epoch one row short of settled forever
             v = float("nan") if qor is None else float(qor)
             g = p.tickets.pop(ticket)
+            p.told.add(ticket)
             finite = v == v and abs(v) != float("inf")
             p.group_value[g] = v if finite else float("nan")
             p.fill(g, p.group_value[g])
@@ -266,6 +417,10 @@ class Session:
                 self._commit()
                 committed = True
             version = self.version
+        # durable-before-ack: the commit record (if this tell
+        # published) hits disk before this method returns a
+        # committed=true the client could act on
+        self._drain_ckpt()
         if obs.journal.enabled():
             # the server-side tuning journal (per-tenant stream): one
             # row per committed tell, so `ut report` over a server's
@@ -314,12 +469,62 @@ class Session:
                                            fail_rate_hi=fail_rate_hi))
             return out
 
+    # -- crash recovery (serve/durable.py) -----------------------------
+    def _replay_commit(self, raw: Sequence[float]) -> None:
+        """Re-publish one committed epoch through the SAME compiled
+        propose/commit programs — no tickets, no config decode: the
+        stream of raw batches alone determines the device state, and
+        `propose` is pure in the state, so the replayed session is
+        bitwise identical to one that never died."""
+        with self.group.lock:
+            ep = self.group.pending_for(self)
+            self.group.commit(self, ep, np.asarray(raw, np.float32))
+            self.version += 1
+            self.pending = None
+
+    def _mark_restored(self, incarn: str) -> None:
+        """Stamp a crash-restored session: a fresh incarnation token
+        (pre-crash tickets are detected, squashed or rejected — never
+        misapplied) and a ticket-id space offset past anything the
+        lost incarnation could have minted (ids are wire handles, not
+        device state, so the offset never touches parity)."""
+        with self.group.lock:
+            self.incarn = str(incarn)
+            self._ticket_seq += 1 << 20
+            # every replayed version came FROM the segment: durable
+            self._ckpt_flushed = self.version
+
+    def _restore_host(self, rec: Dict[str, Any], incarn: str) -> None:
+        """Host-side accounting from the last commit record —
+        checkpointed verbatim because replay preserves values but not
+        tell order, and order is what breaks qor ties."""
+        with self.group.lock:
+            self.best_config = rec.get("best_cfg")
+            bq = rec.get("best_qor")
+            self.best_qor = None if bq is None else float(bq)
+            self.asks = int(rec.get("asks", 0))
+            self.tells = int(rec.get("tells", 0))
+            self.store_served = int(rec.get("served", 0))
+            self._ticket_seq = int(rec.get("tseq", 0))
+            q = rec.get("q")
+            if q is not None:
+                self.quality.restore(q)
+        self._mark_restored(incarn)
+
     def close(self) -> None:
         with self.group.lock:
-            if not self.closed:
-                self.closed = True
-                self.pending = None
-                self.group.leave(self)
+            if self.closed:
+                return
+            self.closed = True
+            self.pending = None
+            self.group.leave(self)
+        # any not-yet-drained commit must land before the close mark,
+        # then the segment is reaped (a recovering server also reaps
+        # segments whose stream ends in a close record)
+        self._drain_ckpt()
+        if self.durable is not None:
+            self.durable.append(self.id, {"ev": "close"})
+            self.durable.reap(self.id)
 
     def _check_open(self) -> None:
         if self.closed:

@@ -51,6 +51,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from .. import obs
+from ..obs import faults
 
 log = logging.getLogger("uptune_tpu")
 
@@ -70,9 +71,22 @@ class WireServer:
     HANDLE_SPAN = "serve.handle"
     _OPS: Dict[str, Callable[..., dict]] = {}
 
+    # connection hardening (ISSUE 15 satellite).  MAX_LINE caps one
+    # request line: a client streaming an unterminated megarequest
+    # gets one error reply and a close instead of growing a buffer
+    # forever.  IDLE_TIMEOUT bounds how long a silent connection may
+    # pin its reader thread (a client that connects and sends nothing
+    # used to hold it until server stop); generous by default because
+    # serve tenants legitimately idle across external builds —
+    # instances may override either before start()
+    MAX_LINE = 1 << 20
+    IDLE_TIMEOUT = 1800.0
+
     def __init__(self, host: str, port: int):
         self.host = str(host)
         self.port = int(port)
+        self.max_line = int(self.MAX_LINE)
+        self.idle_timeout: Optional[float] = self.IDLE_TIMEOUT
         self._lock = threading.RLock()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
@@ -178,6 +192,7 @@ class WireServer:
             except OSError:
                 return      # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            faults.fire("wire.accept")
             self._conns.append(conn)
             # daemon threads are not tracked: _serve_conn prunes its
             # own conn on exit, so a long-lived server's registries
@@ -189,13 +204,41 @@ class WireServer:
             t.start()
 
     def _serve_conn(self, conn: socket.socket, addr) -> None:
+        if self.idle_timeout:
+            # bounded reads: a stalled/silent client times out of its
+            # reader thread instead of pinning it until server stop
+            # (the conn closes on timeout — mid-line resync is not
+            # possible on a byte stream)
+            conn.settimeout(float(self.idle_timeout))
         f = conn.makefile("rwb")
         state = self._conn_opened(conn, addr)
         try:
-            for line in f:
+            while True:
+                try:
+                    line = f.readline(self.max_line + 1)
+                except (TimeoutError, socket.timeout):
+                    obs.count("wire.idle_timeouts")
+                    log.info("[%s] closing idle connection %s",
+                             self.WIRE_NAME, addr)
+                    break
+                if not line:
+                    break
+                if len(line) > self.max_line:
+                    # one complete error reply, then close: the rest
+                    # of the oversized line is unread, so the stream
+                    # cannot be re-synchronized
+                    obs.count("wire.line_cap")
+                    f.write(json.dumps(
+                        {"ok": False,
+                         "error": f"request line exceeds "
+                                  f"{self.max_line} bytes"},
+                        separators=(",", ":")).encode() + b"\n")
+                    f.flush()
+                    break
                 line = line.strip()
                 if not line:
                     continue
+                faults.fire("wire.read")
                 try:
                     req = json.loads(line)
                 except json.JSONDecodeError as e:
@@ -203,6 +246,7 @@ class WireServer:
                 else:
                     resp = self.handle(req)
                     self._on_response(state, req, resp)
+                faults.fire("wire.reply")
                 f.write(json.dumps(resp, separators=(",", ":"))
                         .encode() + b"\n")
                 f.flush()
@@ -233,6 +277,18 @@ class WireServer:
         with self._lock:
             conns = list(self._conns)
         for c in conns:
+            # shutdown BEFORE close: the reader thread's makefile
+            # object holds a reference, so close() alone only drops a
+            # refcount — the fd (and the connection's claim on the
+            # port) would survive until the blocked readline noticed,
+            # which on an idle connection is the idle timeout away.
+            # shutdown unblocks the read immediately, so a stopped
+            # server really releases its port (the restart-in-place
+            # path recovery depends on)
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 c.close()
             except OSError:

@@ -40,11 +40,27 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from .. import obs
+from ..obs import faults
 from .keys import eval_signature, scope_id, trial_key
 
 
 def _finite(q) -> bool:
     return q is not None and q == q and abs(q) != float("inf")
+
+
+def _resolve_fsync(explicit) -> bool:
+    """The store's durability knob (docs/STORE.md "Durability"):
+    explicit argument > UT_STORE_FSYNC env > ut.config('store-fsync')
+    > off.  The O_APPEND protocol already survives process SIGKILL
+    through the page cache; fsync additionally survives power loss at
+    the cost of one disk barrier per recorded build."""
+    if explicit is not None:
+        return bool(explicit)
+    env = os.environ.get("UT_STORE_FSYNC", "").strip().lower()
+    if env:
+        return env in ("1", "true", "yes", "on")
+    from ..api.session import settings
+    return bool(settings.get("store-fsync"))
 
 
 class ResultStore:
@@ -72,9 +88,11 @@ class ResultStore:
                  *, stage: int = 0,
                  extra_files: Optional[Sequence[str]] = None,
                  env: Optional[Dict[str, str]] = None,
-                 refresh_interval: float = 2.0):
+                 refresh_interval: float = 2.0,
+                 fsync: Optional[bool] = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
+        self.fsync = _resolve_fsync(fsync)
         # the session server shares ONE store handle between its
         # per-connection threads (the cross-tenant memo), so the
         # table/offset/segment mutations take a reentrant lock; the
@@ -273,6 +291,10 @@ class ResultStore:
         data = (json.dumps(row, separators=(",", ":"),
                            allow_nan=False) + "\n").encode()
         os.write(self._seg_fd, data)   # one write = one atomic line
+        if self.fsync:
+            # UT_STORE_FSYNC / ut.config('store-fsync'): recorded
+            # builds survive power loss, one barrier per append
+            os.fsync(self._seg_fd)
 
     def record(self, cfg: Dict[str, Any], qor: Optional[float],
                dur: float = 0.0, *, u: Optional[Sequence[float]] = None,
@@ -282,6 +304,7 @@ class ResultStore:
         failure).  Returns the stored row, or None when an equal-or-
         better row for the key already exists (idempotent re-records,
         e.g. archive ingestion over a live store, append nothing)."""
+        faults.fire("store.record")
         with self._lock:
             k = trial_key(self.scope, cfg)
             cur = self._rows.get(k)
