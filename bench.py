@@ -1939,6 +1939,7 @@ def serve_main() -> None:
     import numpy as np
 
     from uptune_tpu import obs
+    from uptune_tpu.analysis.lock_guard import lock_guard_from_env
     from uptune_tpu.analysis.trace_guard import guard_from_env
     from uptune_tpu.api.session import reset_settings
     from uptune_tpu.exec.space_io import records_from_space
@@ -2011,7 +2012,13 @@ def serve_main() -> None:
         return n_asks
 
     # ---------------- phase 1: the multiplexed server -----------------
-    with guard_from_env() as guard:
+    # UT_LOCK_GUARD=1|strict: the runtime lock sanitizer wraps every
+    # lock the serving plane creates in here (server, groups, store,
+    # wire registries) and verdicts cycles/held-too-long on exit —
+    # the dynamic cross-check of lint rules R101–R106, exactly as the
+    # trace guard is R005's (docs/LINT.md)
+    with lock_guard_from_env(name="serve-bench") as lockg, \
+            guard_from_env() as guard:
         srv = SessionServer(port=0, slots=n_sessions,
                             max_sessions=n_sessions + 64,
                             store_dir=store_dir).start()
@@ -2191,6 +2198,95 @@ def serve_main() -> None:
     t_warm = time.perf_counter() - t0
     agg_warm = warm_asks / t_warm
 
+    # ---------------- phase 3 (--quick): lock-sanitizer overhead ------
+    # the shipping bar for leaving UT_LOCK_GUARD on in diagnostic runs:
+    # the SAME handle()-level serving drive (the op surface every
+    # throughput number above is made of) against a server whose locks
+    # were created UNDER an installed LockGuard — every acquire/release
+    # through klock/group/registry pays the proxy bookkeeping — must
+    # hold >= 0.95x the raw-lock server.  Interleaved best-of reps:
+    # this box's throughput swings with co-tenant load (the BENCH_OBS
+    # best-of-N rule), so off/on pairs sample the same weather
+    lock_overhead = None
+    if quick:
+        from uptune_tpu.analysis.lock_guard import LockGuard
+
+        lg_sessions = 4
+
+        def _lg_server(seed0: int):
+            s = SessionServer(port=0, slots=lg_sessions,
+                              max_sessions=lg_sessions + 4,
+                              store_dir="off")
+            sids = []
+            for i in range(lg_sessions):
+                r = s.handle({"op": "open", "space": records,
+                              "seed": seed0 + i, "store": "off"})
+                assert r["ok"], r
+                sids.append(r["session"])
+            return s, sids
+
+        def _lg_drive(s, sids):
+            """One committed epoch wave across every session, through
+            the transport-free dispatch seam (failover phase-1 drive)."""
+            n = 0
+            t0 = time.perf_counter()
+            for sid in sids:
+                done = False
+                while not done:
+                    a = s.handle({"op": "ask", "session": sid, "n": 16})
+                    if not a["trials"]:
+                        done = True
+                        continue
+                    n += len(a["trials"])
+                    res = [{"ticket": t["ticket"],
+                            "qor": measure(t["config"]),
+                            "epoch": t["epoch"]}
+                           for t in a["trials"]]
+                    tl = s.handle({"op": "tell", "session": sid,
+                                   "results": res,
+                                   "incarn": a["incarn"]})
+                    done = bool(tl.get("committed"))
+            return n, time.perf_counter() - t0
+
+        srv_off, sids_off = _lg_server(7000)
+        sanitizer = LockGuard(name="serve-overhead").install()
+        # constructed while installed: THIS server's locks are proxied
+        srv_on, sids_on = _lg_server(7100)
+        try:
+            _lg_drive(srv_off, sids_off)    # warmup pair: compile +
+            _lg_drive(srv_on, sids_on)      # cache fill outside timing
+            off_t, on_t = [], []
+            asks_rep = 0
+            # min-of-7 with rotating order: per-rep walls on this box
+            # swing +-30% with co-tenant load, so both sides must get
+            # enough draws to touch the quiet floor, uncorrelated with
+            # position in the rep
+            for rep in range(7):
+                pair = ((srv_off, sids_off, off_t),
+                        (srv_on, sids_on, on_t))
+                for s_, i_, acc in (pair if rep % 2 == 0
+                                    else pair[::-1]):
+                    n_, t = _lg_drive(s_, i_)
+                    acc.append(t)
+                    asks_rep = n_
+        finally:
+            sanitizer.uninstall()
+            srv_on.stop()
+            srv_off.stop()
+        srep = sanitizer.report()
+        lg_ratio = min(off_t) / min(on_t)
+        lock_overhead = {
+            "guarded_over_unguarded": round(lg_ratio, 4),
+            "bar": 0.95,
+            "bar_met": bool(lg_ratio >= 0.95),
+            "unguarded_best_s": round(min(off_t), 4),
+            "guarded_best_s": round(min(on_t), 4),
+            "asks_per_rep": asks_rep,
+            "acquires": srep["acquires"],
+            "locks": srep["locks"],
+            "cycles": srep["cycles"],
+        }
+
     counters = scrape["metrics"]["counters"]
     result = {
         "metric": "serve_aggregate_asks_per_sec",
@@ -2247,6 +2343,10 @@ def serve_main() -> None:
     }
     if guard.enabled:
         result["retraces"] = guard.report()
+    if lockg.enabled:
+        result["lock_sanitizer"] = lockg.report()
+    if lock_overhead is not None:
+        result["lock_guard_overhead"] = lock_overhead
 
     artifact = {
         **result,
@@ -2297,6 +2397,13 @@ def serve_main() -> None:
     shutil.rmtree(store_dir, ignore_errors=True)
     print(f"bench: serving evidence written to {path}", file=sys.stderr)
     print(json.dumps(result))
+    if lock_overhead is not None and (
+            not lock_overhead["bar_met"] or lock_overhead["cycles"]):
+        print("bench --serve: lock-sanitizer gate FAILED "
+              f"(ratio {lock_overhead['guarded_over_unguarded']} vs "
+              f"bar {lock_overhead['bar']}, "
+              f"cycles {lock_overhead['cycles']})", file=sys.stderr)
+        sys.exit(1)
 
 
 def failover_main() -> None:
@@ -2338,6 +2445,7 @@ def failover_main() -> None:
 
     import numpy as np
 
+    from uptune_tpu.analysis.lock_guard import lock_guard_from_env
     from uptune_tpu.analysis.trace_guard import TraceGuard
     from uptune_tpu.api.session import reset_settings
     from uptune_tpu.exec.space_io import records_from_space
@@ -2346,6 +2454,11 @@ def failover_main() -> None:
     from uptune_tpu.workloads import rosenbrock_space
 
     reset_settings()
+    # UT_LOCK_GUARD: sanitize the whole bench — overhead drives, the
+    # in-process recovery server, checkpoint plane, clients.  The
+    # crashed subprocess inherits the env but installs nothing, so the
+    # kill itself is unaffected
+    lockg = lock_guard_from_env(name="failover-bench").install()
     repo = os.path.dirname(os.path.abspath(__file__))
     workdir = tempfile.mkdtemp(prefix="ut_failover_bench_")
     result: dict = {"metric": "serve_failover", "quick": quick,
@@ -2592,6 +2705,10 @@ def failover_main() -> None:
           file=sys.stderr)
 
     shutil.rmtree(workdir, ignore_errors=True)
+    lockg.uninstall()
+    if lockg.enabled:
+        result["lock_sanitizer"] = lockg.report()
+        lockg.check()   # strict: raise on any lock-order cycle
     # the throughput bar gates only the FULL run (the BENCH_OBS /
     # BENCH_FLEET co-tenant-noise rule): a --quick single rep on this
     # shared box swings well past 5% — the quick smoke gates the

@@ -22,6 +22,13 @@ args=(uptune_tpu/ bench.py scripts/ --format text)
 if [ -f scripts/lint_baseline.json ]; then
     args+=(--baseline scripts/lint_baseline.json)
 fi
+# UT_LINT_CHANGED=1: diff-scoped pre-commit loop — lint only files
+# changed vs UT_LINT_BASE (default HEAD) plus untracked ones.  The
+# suppression-free sweep below still runs package-wide, so the
+# cross-module rules (R101) keep their full view
+if [ "${UT_LINT_CHANGED:-0}" = "1" ]; then
+    args+=(--changed --changed-base "${UT_LINT_BASE:-HEAD}")
+fi
 "${PYTHON:-python3}" -m uptune_tpu.analysis "${args[@]}"
 
 # uptune_tpu/store/, uptune_tpu/surrogate/, uptune_tpu/engine/,

@@ -1,0 +1,708 @@
+"""Concurrency pass: fixture-proven positives/negatives/suppressions
+for R101-R106, the LockGuard runtime sanitizer, the --changed CLI, and
+the repo-wide concurrency-clean gate.
+
+Static fixtures lint as strings (lint_source) — no files, no jax.  The
+LockGuard tests run real threads but never import jax; the strict
+smoke drives the actual jax-free serving/store primitives (checkpoint
+log, result store, wire server) under an installed strict guard.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from uptune_tpu.analysis import lint_source
+from uptune_tpu.analysis.lock_guard import (LockGuard, LockOrderError,
+                                            lock_guard_from_env)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fixture(src):
+    return textwrap.dedent(src).lstrip("\n")
+
+
+def active(src, rule=None):
+    fs = lint_source("fixture.py", fixture(src))
+    assert not any(f.rule == "E000" for f in fs), \
+        f"fixture failed to parse: {fs}"
+    fs = [f for f in fs if not f.suppressed]
+    if rule is not None:
+        fs = [f for f in fs if f.rule == rule]
+    return fs
+
+
+def suppressed(src, rule):
+    fs = lint_source("fixture.py", fixture(src))
+    return [f for f in fs if f.suppressed and f.rule == rule]
+
+
+# ---------------------------------------------------------------- R101
+class TestLockOrderInversion:
+    def test_positive_both_sites_flagged(self):
+        fs = active("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def two(self):
+                    with self._b:
+                        with self._a:
+                            return 2
+        """, "R101")
+        # one finding per direction's nesting site
+        assert len(fs) == 2
+        assert {f.line for f in fs} == {10, 15}
+        assert all("inversion" in f.message for f in fs)
+
+    def test_negative_consistent_order(self):
+        fs = active("""
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:
+                            return 1
+
+                def two(self):
+                    with self._a:
+                        with self._b:
+                            return 2
+        """, "R101")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import threading
+
+            class S:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def one(self):
+                    with self._a:
+                        with self._b:  # ut-lint: disable=R101
+                            return 1
+
+                def two(self):
+                    with self._b:
+                        with self._a:  # ut-lint: disable=R101
+                            return 2
+        """
+        assert active(src, "R101") == []
+        assert len(suppressed(src, "R101")) == 2
+
+
+# ---------------------------------------------------------------- R102
+class TestBlockingUnderLock:
+    def test_positive_fsync(self):
+        fs = active("""
+            import os
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush(self, fd):
+                    with self._lock:
+                        os.fsync(fd)
+        """, "R102")
+        assert len(fs) == 1 and fs[0].line == 10
+        assert "os.fsync" in fs[0].message
+
+    def test_positive_socket_and_sleep(self):
+        fs = active("""
+            import time
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def send(self, sock, data):
+                    with self._lock:
+                        sock.sendall(data)
+                        time.sleep(0.1)
+        """, "R102")
+        assert len(fs) == 2
+
+    def test_positive_transitive_intra_class(self):
+        # the store's record -> _append -> fsync seam
+        fs = active("""
+            import os
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _append(self, fd, data):
+                    os.write(fd, data)
+                    os.fsync(fd)
+
+                def record(self, fd, data):
+                    with self._lock:
+                        self._append(fd, data)
+        """, "R102")
+        assert len(fs) == 1 and fs[0].line == 14
+        assert "_append" in fs[0].message
+
+    def test_negative_outside_lock_and_buffered_write(self):
+        # snapshot-under-lock / block-outside, and buffered writes
+        # under a lock (the append discipline) are both fine
+        fs = active("""
+            import os
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush(self, fd, f, data):
+                    with self._lock:
+                        os.write(fd, data)
+                        f.write(data)
+                        f.flush()
+                    os.fsync(fd)
+        """, "R102")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import os
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def flush(self, fd):
+                    with self._lock:
+                        os.fsync(fd)  # ut-lint: disable=R102
+        """
+        assert active(src, "R102") == []
+        assert len(suppressed(src, "R102")) == 1
+
+
+# ---------------------------------------------------------------- R103
+class TestUnguardedSharedField:
+    SRC = """
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def _run(self):
+                {access}
+
+            def stop(self):
+                self._t.join()
+    """
+
+    def test_positive_bare_access_in_thread_entry(self):
+        fs = active(self.SRC.format(access="self._n = 0"), "R103")
+        assert len(fs) == 1 and fs[0].line == 15
+        assert "_n" in fs[0].message
+
+    def test_negative_locked_access_in_thread_entry(self):
+        fs = active("""
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def _run(self):
+                    with self._lock:
+                        self._n = 0
+
+                def stop(self):
+                    self._t.join()
+        """, "R103")
+        assert fs == []
+
+    def test_negative_no_threads(self):
+        fs = active("""
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def peek(self):
+                    return self._n
+        """, "R103")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = self.SRC.format(
+            access="self._n = 0  # ut-lint: disable=R103")
+        assert active(src, "R103") == []
+        assert len(suppressed(src, "R103")) == 1
+
+
+# ---------------------------------------------------------------- R104
+class TestAckBeforeDurable:
+    def test_positive_commit_acked_without_drain(self):
+        fs = active("""
+            from uptune_tpu.serve import durable
+
+            class H:
+                def _drain_ckpt(self, sid):
+                    pass
+
+                def op_tell(self, st, sid):
+                    self.state._commit()
+                    return {"committed": True}
+        """, "R104")
+        assert len(fs) == 1 and fs[0].line == 8
+
+    def test_negative_drain_after_commit(self):
+        fs = active("""
+            from uptune_tpu.serve import durable
+
+            class H:
+                def _drain_ckpt(self, sid):
+                    pass
+
+                def op_tell(self, st, sid):
+                    self.state._commit()
+                    self._drain_ckpt(sid)
+                    return {"committed": True}
+        """, "R104")
+        assert fs == []
+
+    def test_negative_out_of_scope_module(self):
+        # no durable import and no drain seam: commit+return is not a
+        # serving ack path
+        fs = active("""
+            class Repo:
+                def save(self, txn):
+                    txn._commit()
+                    return True
+        """, "R104")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            from uptune_tpu.serve import durable
+
+            class H:
+                def _drain_ckpt(self, sid):
+                    pass
+
+                def op_tell(self, st, sid):
+                    self.state._commit()  # ut-lint: disable=R104
+                    return {"committed": True}
+        """
+        assert active(src, "R104") == []
+        assert len(suppressed(src, "R104")) == 1
+
+
+# ---------------------------------------------------------------- R105
+class TestThreadWithoutJoin:
+    def test_positive_untracked_start(self):
+        fs = active("""
+            import threading
+
+            def kick(fn):
+                threading.Thread(target=fn, daemon=True).start()
+        """, "R105")
+        assert len(fs) == 1 and fs[0].line == 4
+
+    def test_positive_container_never_joined(self):
+        fs = active("""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._threads = []
+
+                def spawn(self, fn):
+                    self._threads.append(
+                        threading.Thread(target=fn, daemon=True))
+        """, "R105")
+        assert len(fs) == 1
+
+    def test_negative_joined_via_container(self):
+        fs = active("""
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._threads = []
+
+                def spawn(self, fn):
+                    t = threading.Thread(target=fn, daemon=True)
+                    self._threads.append(t)
+                    t.start()
+
+                def stop(self):
+                    for t in list(self._threads):
+                        t.join(timeout=2.0)
+        """, "R105")
+        assert fs == []
+
+    def test_negative_direct_join(self):
+        fs = active("""
+            import threading
+
+            def run(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+        """, "R105")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import threading
+
+            def kick(fn):
+                # fire-and-forget by design: dies with the process
+                threading.Thread(  # ut-lint: disable=R105
+                    target=fn, daemon=True).start()
+        """
+        assert active(src, "R105") == []
+        assert len(suppressed(src, "R105")) == 1
+
+
+# ---------------------------------------------------------------- R106
+class TestConditionWaitNoPredicate:
+    def test_positive_bare_wait(self):
+        fs = active("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def get(self):
+                    with self._cv:
+                        self._cv.wait()
+                        return 1
+        """, "R106")
+        assert len(fs) == 1 and fs[0].line == 9
+
+    def test_negative_while_predicate(self):
+        fs = active("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._items = []
+
+                def get(self):
+                    with self._cv:
+                        while not self._items:
+                            self._cv.wait()
+                        return self._items.pop()
+        """, "R106")
+        assert fs == []
+
+    def test_negative_wait_for_and_event(self):
+        fs = active("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._ev = threading.Event()
+                    self._items = []
+
+                def get(self):
+                    with self._cv:
+                        self._cv.wait_for(lambda: self._items)
+                    self._ev.wait()
+        """, "R106")
+        assert fs == []
+
+    def test_suppressed(self):
+        src = """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def get(self):
+                    with self._cv:
+                        self._cv.wait()  # ut-lint: disable=R106
+                        return 1
+        """
+        assert active(src, "R106") == []
+        assert len(suppressed(src, "R106")) == 1
+
+
+# ----------------------------------------------------------- LockGuard
+class TestLockGuard:
+    def test_clean_nesting_no_findings(self):
+        with LockGuard(strict=True, name="t-clean") as g:
+            a = threading.Lock()
+            b = threading.Lock()
+            with a:
+                with b:
+                    pass
+            with a:
+                with b:
+                    pass
+        assert g.ok()
+        rep = g.report()
+        assert rep["cycles"] == [] and rep["acquires"] >= 4
+
+    def test_cycle_detected_sequential_interleave(self):
+        # AB then BA run to completion on separate threads: the order
+        # graph accumulates across time, so the cycle is detected with
+        # no actual deadlock.  Locks MUST be allocated on separate
+        # lines — the guard keys identity by allocation site
+        g = LockGuard(name="t-cycle").install()
+        try:
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def ab():
+                with a:
+                    with b:
+                        pass
+
+            def ba():
+                with b:
+                    with a:
+                        pass
+
+            t = threading.Thread(target=ab)
+            t.start()
+            t.join()
+            t = threading.Thread(target=ba)
+            t.start()
+            t.join()
+        finally:
+            g.uninstall()
+        rep = g.report()
+        assert len(rep["cycles"]) == 1
+        assert not g.ok()
+
+    def test_strict_raises_on_exit(self):
+        with pytest.raises(LockOrderError, match="cycle"):
+            with LockGuard(strict=True, name="t-strict") as g:
+                a = threading.Lock()
+                b = threading.Lock()
+                with a:
+                    with b:
+                        pass
+                with b:
+                    with a:
+                        pass
+        assert not g.ok()
+
+    def test_warn_mode_warns_not_raises(self):
+        with pytest.warns(RuntimeWarning, match="cycle"):
+            with LockGuard(strict=False, name="t-warn"):
+                a = threading.Lock()
+                b = threading.Lock()
+                with a:
+                    with b:
+                        pass
+                with b:
+                    with a:
+                        pass
+
+    def test_held_too_long(self):
+        with pytest.raises(LockOrderError, match="held-too-long"):
+            with LockGuard(strict=True, held_ms=5.0, name="t-held") as g:
+                lk = threading.Lock()
+                with lk:
+                    time.sleep(0.02)
+        assert g.report()["held_too_long"]
+        assert g.report()["held_max_ms"] >= 5.0
+
+    def test_rlock_reentrancy_and_condition(self):
+        with LockGuard(strict=True, name="t-rlock") as g:
+            r = threading.RLock()
+            with r:
+                with r:         # reentrant: outermost-only reporting
+                    pass
+            cv = threading.Condition()
+            hit = []
+
+            def waiter():
+                with cv:
+                    while not hit:
+                        cv.wait(timeout=5.0)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                hit.append(1)
+                cv.notify_all()
+            t.join(timeout=5.0)
+            assert not t.is_alive()
+        assert g.ok()
+
+    def test_env_gating(self, monkeypatch):
+        monkeypatch.delenv("UT_LOCK_GUARD", raising=False)
+        g = lock_guard_from_env()
+        assert not g.enabled
+        g.install()     # inert: must not patch
+        assert threading.Lock is not g and not g._active
+        monkeypatch.setenv("UT_LOCK_GUARD", "strict")
+        g = lock_guard_from_env()
+        assert g.enabled and g.strict
+        monkeypatch.setenv("UT_LOCK_GUARD", "warn")
+        monkeypatch.setenv("UT_LOCK_GUARD_MS", "250")
+        g = lock_guard_from_env()
+        assert g.enabled and not g.strict and g.held_ms == 250.0
+
+    def test_uninstall_restores_factories(self):
+        orig_lock, orig_rlock = threading.Lock, threading.RLock
+        g = LockGuard(name="t-restore").install()
+        assert threading.Lock is not orig_lock
+        g.uninstall()
+        assert threading.Lock is orig_lock
+        assert threading.RLock is orig_rlock
+
+
+class TestLockGuardStrictSmoke:
+    """Strict guard over the real jax-free serving/store primitives:
+    zero findings expected — this is the cheap in-suite proxy for the
+    `bench.py --serve --quick` acceptance run."""
+
+    def test_durable_store_wire_clean(self, tmp_path):
+        from uptune_tpu.serve.durable import CheckpointLog
+        from uptune_tpu.serve.wire import WireServer
+        from uptune_tpu.store.store import ResultStore
+
+        class Ping(WireServer):
+            WIRE_NAME = "t-ping"
+
+            def _op_ping(self, req):
+                return {"pong": True}
+            _OPS = {"ping": _op_ping}
+
+        with LockGuard(strict=True, name="t-smoke") as g:
+            ckpt = CheckpointLog(str(tmp_path / "ckpt"), fsync=True)
+            assert ckpt.append("s1", {"ev": "open", "v": 0})
+            assert ckpt.append("s1", {"ev": "commit", "v": 1})
+
+            st = ResultStore(str(tmp_path / "store"),
+                             ["x:int:0:8"], "true", fsync=True)
+            for i in range(4):
+                st.record({"x": i}, qor=float(i))
+            assert st.lookup({"x": 2}) is not None
+            st.compact()
+            assert st.lookup({"x": 2}) is not None
+
+            srv = Ping(host="127.0.0.1", port=0).start()
+            import socket
+            with socket.create_connection(
+                    ("127.0.0.1", srv.port), timeout=5) as c:
+                f = c.makefile("rwb")
+                f.write(b'{"op": "ping"}\n')
+                f.flush()
+                resp = json.loads(f.readline())
+                assert resp["ok"] and resp["pong"]
+            srv.stop()
+        assert g.ok(), g.report()
+        assert g.report()["acquires"] > 0
+
+
+# ------------------------------------------------------------- changed
+class TestChangedScoping:
+    def _git(self, cwd, *args):
+        return subprocess.run(["git", *args], cwd=cwd,
+                              capture_output=True, text=True)
+
+    def test_changed_lints_only_dirty_files(self, tmp_path):
+        if self._git(tmp_path, "init", "-q").returncode != 0:
+            pytest.skip("git unavailable")
+        self._git(tmp_path, "config", "user.email", "t@t")
+        self._git(tmp_path, "config", "user.name", "t")
+        clean = tmp_path / "clean.py"
+        dirty = tmp_path / "dirty.py"
+        bad = ("import threading\n\n"
+               "def kick(fn):\n"
+               "    threading.Thread(target=fn).start()\n")
+        clean.write_text(bad)    # committed hazard: out of scope
+        dirty.write_text("x = 1\n")
+        self._git(tmp_path, "add", ".")
+        self._git(tmp_path, "commit", "-q", "-m", "seed")
+        dirty.write_text(bad)    # NEW hazard in the diff
+        r = subprocess.run(
+            [sys.executable, "-m", "uptune_tpu.analysis", ".",
+             "--changed", "--select", "R105"],
+            cwd=tmp_path, capture_output=True, text=True,
+            env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "dirty.py" in r.stdout
+        assert "clean.py" not in r.stdout
+
+    def test_changed_falls_back_without_git(self, tmp_path):
+        f = tmp_path / "mod.py"
+        f.write_text("import threading\n\n"
+                     "def kick(fn):\n"
+                     "    threading.Thread(target=fn).start()\n")
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   GIT_DIR=str(tmp_path / "no-such-repo"))
+        r = subprocess.run(
+            [sys.executable, "-m", "uptune_tpu.analysis", ".",
+             "--changed", "--select", "R105"],
+            cwd=tmp_path, capture_output=True, text=True, env=env)
+        # full-lint fallback still finds the hazard
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "falling back to full lint" in r.stderr
+
+
+# ----------------------------------------------------------- repo gate
+class TestRepoConcurrencyClean:
+    def test_repo_clean_under_concurrency_rules(self):
+        """The concurrency pass holds repo-wide with zero unsuppressed
+        findings (the R101-R106 half of scripts/lint.sh)."""
+        r = subprocess.run(
+            [sys.executable, "-m", "uptune_tpu.analysis",
+             "uptune_tpu/", "bench.py", "scripts/",
+             "--select", "R101,R102,R103,R104,R105,R106"],
+            cwd=REPO, capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr

@@ -12,10 +12,19 @@ Runtime side::
     with TraceGuard(limit=2) as tg:
         ...   # anything jitted in here gets its traces counted
 
+Concurrency side (R101–R106 + the lock sanitizer)::
+
+    UT_LOCK_GUARD=strict python bench.py --serve --quick
+    from uptune_tpu.analysis import LockGuard
+    with LockGuard(strict=True):
+        ...   # locks created in here get order/held-time checked
+
 Rules, suppression syntax, and the throughput rationale: docs/LINT.md.
 """
 from .core import Finding, all_rules, lint_paths, lint_source
+from .lock_guard import LockGuard, LockOrderError, lock_guard_from_env
 from .trace_guard import RetraceError, TraceGuard, guard_from_env
 
 __all__ = ["Finding", "all_rules", "lint_paths", "lint_source",
-           "TraceGuard", "RetraceError", "guard_from_env"]
+           "TraceGuard", "RetraceError", "guard_from_env",
+           "LockGuard", "LockOrderError", "lock_guard_from_env"]

@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 from typing import List, Optional, Set
 
@@ -39,6 +40,44 @@ def _write_baseline(path: str, findings: List[Finding]) -> int:
     return len(fps)
 
 
+def _git_changed(base: str) -> Optional[List[str]]:
+    """Changed (vs ``base``) plus untracked ``*.py`` files, as
+    cwd-relative paths — or None when git is unavailable or the ref is
+    bad, so the caller can fall back to a full lint rather than
+    silently passing an unlinted change."""
+    def run(*a: str) -> "subprocess.CompletedProcess[str]":
+        return subprocess.run(["git", *a], capture_output=True,
+                              text=True)
+    try:
+        top = run("rev-parse", "--show-toplevel")
+        diff = run("diff", "--name-only", "--diff-filter=d", base,
+                   "--", "*.py")
+        extra = run("ls-files", "--others", "--exclude-standard",
+                    "--", "*.py")
+    except OSError:
+        return None
+    if top.returncode or diff.returncode or extra.returncode:
+        return None
+    root = top.stdout.strip()
+    names = (set(diff.stdout.splitlines())
+             | set(extra.stdout.splitlines()))
+    out = []
+    for n in sorted(n for n in names if n.strip()):
+        p = os.path.relpath(os.path.join(root, n))
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def _in_scope(path: str, roots: List[str]) -> bool:
+    ap = os.path.abspath(path)
+    for r in roots:
+        ar = os.path.abspath(r)
+        if ap == ar or ap.startswith(ar + os.sep):
+            return True
+    return False
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ut-lint",
@@ -59,6 +98,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--write-baseline", metavar="FILE",
                     help="write current findings as the new baseline "
                          "and exit 0")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs --changed-base "
+                         "(git diff + untracked), intersected with "
+                         "the requested paths; falls back to a full "
+                         "lint if git fails")
+    ap.add_argument("--changed-base", metavar="REF", default="HEAD",
+                    help="base ref for --changed (default: HEAD)")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include '# ut-lint: disable' findings in "
                          "text/json output")
@@ -94,6 +140,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     if missing:
         print(f"ut-lint: no such path(s): {missing}", file=sys.stderr)
         return 2
+
+    if args.changed:
+        changed = _git_changed(args.changed_base)
+        if changed is None:
+            # better to lint everything than to green-light a change
+            # the diff scoping could not see
+            print("ut-lint: --changed: git unavailable or bad ref "
+                  f"{args.changed_base!r}; falling back to full lint",
+                  file=sys.stderr)
+        else:
+            scoped = [c for c in changed if _in_scope(c, paths)]
+            print(f"ut-lint: --changed vs {args.changed_base}: "
+                  f"{len(scoped)} file(s) in scope", file=sys.stderr)
+            # note: package-wide rules (R101) only see the changed
+            # modules under --changed; the full gate still runs them
+            # repo-wide
+            paths = scoped
 
     findings = lint_paths(paths, select)
 

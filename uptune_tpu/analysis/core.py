@@ -77,6 +77,24 @@ class Rule:
         raise NotImplementedError
 
 
+class PackageRule(Rule):
+    """A rule whose verdict needs EVERY linted module at once (the
+    lock-order inversion check: the two halves of an inverted pair
+    usually live in different files).  `lint_paths` calls
+    `check_package` exactly once over the whole module set; linting a
+    single file degrades gracefully to that one module — full coverage
+    comes from the repo-wide gate run (scripts/lint.sh)."""
+
+    def check(self, mod: "ModuleCtx") -> Iterator:
+        for m, node, message in self.check_package([mod]):
+            if m is mod:
+                yield node, message
+
+    def check_package(self, mods: Sequence["ModuleCtx"]) -> Iterator:
+        """Yield (mod, node, message) triples across all modules."""
+        raise NotImplementedError
+
+
 _REGISTRY: Dict[str, Rule] = {}
 
 
@@ -91,6 +109,7 @@ def register(cls):
 
 def all_rules() -> Dict[str, Rule]:
     from . import rules as _rules  # noqa: F401  (registration side effect)
+    from . import conc_rules as _conc  # noqa: F401  (R101–R106)
     return dict(_REGISTRY)
 
 
@@ -120,6 +139,16 @@ class ModuleCtx:
         self.parents = self._build_parents(self.tree)
         from .jitgraph import JitGraph
         self.jit = JitGraph(self)
+        self._locks = None
+
+    @property
+    def locks(self):
+        """Lazy LockGraph (the concurrency pass; `lockgraph.py`) —
+        built on first use so jit-only tooling pays nothing for it."""
+        if self._locks is None:
+            from .lockgraph import LockGraph
+            self._locks = LockGraph(self)
+        return self._locks
 
     # -- imports ------------------------------------------------------
     @staticmethod
@@ -221,29 +250,19 @@ def function_body(fn) -> List[ast.AST]:
 
 
 # ---------------------------------------------------------------------
-def lint_source(path: str, source: str,
-                select: Optional[Set[str]] = None) -> List[Finding]:
-    """Lint one module's source; returns findings INCLUDING suppressed
-    ones (marked), sorted by position.  Syntax errors yield a single
-    parse-error finding under rule id 'E000'."""
-    try:
-        mod = ModuleCtx(path, source)
-    except SyntaxError as e:
-        return [Finding("E000", path, e.lineno or 1, e.offset or 0,
-                        f"syntax error: {e.msg}", snippet="")]
-    findings: List[Finding] = []
-    for rid, rule in sorted(all_rules().items()):
-        if select is not None and rid not in select:
-            continue
-        for node, message in rule.check(mod):
-            line = getattr(node, "lineno", 1)
-            col = getattr(node, "col_offset", 0)
-            findings.append(Finding(
-                rid, path, line, col, message,
-                snippet=mod.snippet(line),
-                suppressed=mod.is_suppressed(rid, line)))
-    # one finding per (rule, line, col): loop double-execution in the
-    # key-reuse interpreter can emit duplicates
+def _mk_finding(mod: ModuleCtx, rid: str, node, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    return Finding(rid, mod.path, line, col, message,
+                   snippet=mod.snippet(line),
+                   suppressed=mod.is_suppressed(rid, line))
+
+
+def _finalize(findings: List[Finding]) -> List[Finding]:
+    """Per-module finishing: position sort, one finding per
+    (rule, line, col) — loop double-execution in the key-reuse
+    interpreter can emit duplicates — and occurrence ordinals for the
+    count-based fingerprint semantics."""
     seen: Set[tuple] = set()
     out = []
     for f in sorted(findings, key=lambda f: (f.line, f.col, f.rule)):
@@ -257,6 +276,26 @@ def lint_source(path: str, source: str,
         f.occurrence = counts.get(fk, 0)
         counts[fk] = f.occurrence + 1
     return out
+
+
+def lint_source(path: str, source: str,
+                select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint one module's source; returns findings INCLUDING suppressed
+    ones (marked), sorted by position.  Syntax errors yield a single
+    parse-error finding under rule id 'E000'.  Package rules see just
+    this module (their single-module fallback)."""
+    try:
+        mod = ModuleCtx(path, source)
+    except SyntaxError as e:
+        return [Finding("E000", path, e.lineno or 1, e.offset or 0,
+                        f"syntax error: {e.msg}", snippet="")]
+    findings: List[Finding] = []
+    for rid, rule in sorted(all_rules().items()):
+        if select is not None and rid not in select:
+            continue
+        for node, message in rule.check(mod):
+            findings.append(_mk_finding(mod, rid, node, message))
+    return _finalize(findings)
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
@@ -275,14 +314,47 @@ def iter_py_files(paths: Sequence[str]) -> Iterator[str]:
 
 def lint_paths(paths: Sequence[str],
                select: Optional[Set[str]] = None) -> List[Finding]:
-    findings: List[Finding] = []
+    """Lint a path set.  Per-module rules run module by module;
+    PackageRules run ONCE over every successfully parsed module (the
+    lock-order inversion pair may span files).  Output order and the
+    per-module fingerprint semantics match the old per-file path."""
+    by_path: Dict[str, List[Finding]] = {}
+    order: List[str] = []
+    mods: List[ModuleCtx] = []
     for fp in iter_py_files(paths):
+        rel = os.path.relpath(fp)
+        if rel in by_path:
+            continue
+        order.append(rel)
+        by_path[rel] = []
         try:
             with open(fp, encoding="utf-8") as f:
                 src = f.read()
         except (OSError, UnicodeDecodeError) as e:
-            findings.append(Finding("E000", os.path.relpath(fp), 1, 0,
-                                    f"unreadable: {e}"))
+            by_path[rel].append(Finding("E000", rel, 1, 0,
+                                        f"unreadable: {e}"))
             continue
-        findings.extend(lint_source(os.path.relpath(fp), src, select))
-    return findings
+        try:
+            mods.append(ModuleCtx(rel, src))
+        except SyntaxError as e:
+            by_path[rel].append(Finding(
+                "E000", rel, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}", snippet=""))
+    rules = sorted(all_rules().items())
+    for mod in mods:
+        for rid, rule in rules:
+            if select is not None and rid not in select:
+                continue
+            if isinstance(rule, PackageRule):
+                continue
+            for node, message in rule.check(mod):
+                by_path[mod.path].append(
+                    _mk_finding(mod, rid, node, message))
+    for rid, rule in rules:
+        if select is not None and rid not in select:
+            continue
+        if not isinstance(rule, PackageRule):
+            continue
+        for mod, node, message in rule.check_package(mods):
+            by_path[mod.path].append(_mk_finding(mod, rid, node, message))
+    return [f for rel in order for f in _finalize(by_path[rel])]

@@ -120,21 +120,29 @@ class CheckpointLog:
         faults.fire("ckpt.append")
         data = (json.dumps(record, separators=(",", ":"),
                            allow_nan=False) + "\n").encode()
+        fd = -1
         try:
+            # open+write under the lock (same-session append order);
+            # fsync OUTSIDE it — fsync flushes the whole inode, so by
+            # the time THIS append's fsync returns, this record and
+            # every earlier one are durable, and the caller's reply
+            # still strictly follows its own record's durability.
+            # Holding a lock across fsync serializes every concurrent
+            # session behind one disk flush (R102).
             with self._lock:
                 fd = os.open(self.path_for(sid),
                              os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                              0o644)
-                try:
-                    os.write(fd, data)   # one write = one atomic line
-                    if self.fsync:
-                        os.fsync(fd)
-                finally:
-                    os.close(fd)
+                os.write(fd, data)       # one write = one atomic line
+            if self.fsync:
+                os.fsync(fd)
         except OSError:
             self.errors += 1
             obs.count("serve.ckpt_errors")
             return False
+        finally:
+            if fd >= 0:
+                os.close(fd)
         self.appends += 1
         obs.count("serve.ckpt_appends")
         return True

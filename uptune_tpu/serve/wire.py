@@ -180,7 +180,8 @@ class WireServer:
                              name=f"{self.WIRE_NAME}-accept",
                              daemon=True)
         t.start()
-        self._threads.append(t)
+        with self._lock:
+            self._threads.append(t)
         log.info("[%s] listening on %s:%d%s", self.WIRE_NAME,
                  self.host, self.port, self._listen_banner())
         return self
@@ -193,14 +194,18 @@ class WireServer:
                 return      # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             faults.fire("wire.accept")
-            self._conns.append(conn)
-            # daemon threads are not tracked: _serve_conn prunes its
-            # own conn on exit, so a long-lived server's registries
-            # stay bounded by LIVE connections under open/close churn
             t = threading.Thread(target=self._serve_conn,
                                  args=(conn, addr),
                                  name=f"{self.WIRE_NAME}-{addr[1]}",
                                  daemon=True)
+            # both registries mutate under _lock everywhere, so
+            # stop()'s shutdown snapshot is never a torn read;
+            # _serve_conn prunes its own entries on exit, keeping a
+            # long-lived server's registries bounded by LIVE
+            # connections under open/close churn
+            with self._lock:
+                self._conns.append(conn)
+                self._threads.append(t)
             t.start()
 
     def _serve_conn(self, conn: socket.socket, addr) -> None:
@@ -258,10 +263,14 @@ class WireServer:
                 conn.close()
             except OSError:
                 pass
-            try:
-                self._conns.remove(conn)
-            except ValueError:
-                pass    # stop() already swept it
+            with self._lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass    # stop() already swept it
+                me = threading.current_thread()
+                if me in self._threads:
+                    self._threads.remove(me)
             self._conn_closed(state)
 
     def stop(self) -> None:
@@ -293,6 +302,16 @@ class WireServer:
                 c.close()
             except OSError:
                 pass
+        # bounded join: handler threads unblock the moment their conn
+        # is shut down above, and the accept thread exits on the
+        # closed listener — joining makes stop() a real barrier, so no
+        # handler races interpreter teardown writing to closed sockets
+        with self._lock:
+            threads = list(self._threads)
+        me = threading.current_thread()
+        for t in threads:
+            if t is not me:     # a handler op may itself call stop()
+                t.join(timeout=2.0)
 
     def serve_forever(self) -> None:
         """start() + block until KeyboardInterrupt (the CLI path)."""

@@ -278,12 +278,18 @@ class ResultStore:
             return out
 
     # -- writes --------------------------------------------------------
-    def _append(self, row: Dict[str, Any]) -> None:
+    def _append(self, row: Dict[str, Any]) -> Optional[int]:
+        """Write one row to the segment (caller holds ``_io_lock``).
+        With fsync on, returns a dup'd fd for the caller to flush
+        OUTSIDE the lock — fsync is inode-wide, so the dup covers this
+        append even if the original fd is closed meanwhile; holding
+        ``_io_lock`` across the barrier would queue every concurrent
+        append behind one disk flush (R102).  Returns None otherwise."""
         if self._closed:
             # a record() racing close() (server stop vs an in-flight
             # tell) must not resurrect the segment: reopening here
             # would leak the fd and leave a stray seg file behind
-            return
+            return None
         if self._seg_fd is None:
             self._seg_fd = os.open(
                 self._seg_path,
@@ -294,7 +300,8 @@ class ResultStore:
         if self.fsync:
             # UT_STORE_FSYNC / ut.config('store-fsync'): recorded
             # builds survive power loss, one barrier per append
-            os.fsync(self._seg_fd)
+            return os.dup(self._seg_fd)
+        return None
 
     def record(self, cfg: Dict[str, Any], qor: Optional[float],
                dur: float = 0.0, *, u: Optional[Sequence[float]] = None,
@@ -330,7 +337,16 @@ class ResultStore:
         # ORDER across threads is irrelevant — rows are keyed and
         # duplicate keys merge away on load
         with self._io_lock:
-            self._append(row)
+            fd = self._append(row)
+        if fd is not None:
+            # the durability barrier runs outside BOTH locks on the
+            # dup'd fd; the row is on disk when record() returns, the
+            # memo-before-reply contract, without serializing other
+            # threads' appends behind the flush
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
         return row
 
     def ingest_archive(self, path: str) -> int:
@@ -361,46 +377,59 @@ class ResultStore:
     # -- maintenance ---------------------------------------------------
     def compact(self) -> int:
         """Merge every visible row into a fresh ``base.jsonl`` (atomic
-        rename) and truncate this instance's own segment.  Other
+        rename) and retire this instance's own segment.  Other
         instances' segments are left alone — their rows are now ALSO in
         the base, and duplicate keys merge away on load.
 
-        Under ``_lock`` like the serving-path methods: a shared-handle
-        tenant thread's record() must not grow ``_rows`` mid-iteration
-        or write to the segment fd while compact closes it."""
+        The whole-store write + fsync runs OUTSIDE the locks (a
+        shared-handle tenant's lookup/record must not queue behind a
+        full disk flush — R102); correctness comes from rotating the
+        segment first: under ``_lock``+``_io_lock`` the own segment is
+        closed and renamed to a ``seg-*-old.jsonl`` name that still
+        matches the sibling scan pattern, so (a) any record() landing
+        mid-compact reopens a FRESH segment and its row survives the
+        retirement, and (b) a crash before the base rename loses
+        nothing — the rotated segment is still scanned on next load."""
         with self._lock:
             self.refresh()
+            old: Optional[str] = os.path.join(
+                self.root, f"seg-{self.instance}-old.jsonl")
+            with self._io_lock:
+                if self._seg_fd is not None:
+                    os.close(self._seg_fd)
+                    self._seg_fd = None
+                try:
+                    os.rename(self._seg_path, old)
+                except OSError:
+                    old = None          # no segment yet
+            self._offsets.pop(self._seg_path, None)
+            snapshot = list(self._rows.values())
             # per-instance tmp name: two siblings compacting
             # concurrently must not truncate each other's in-flight
             # snapshot (each publishes a FULL merged view, so
             # last-rename-wins is safe)
             tmp = os.path.join(self.root,
                                f"base.jsonl.{self.instance}.tmp")
-            with open(tmp, "w") as f:
-                for row in self._rows.values():
-                    f.write(json.dumps(row, separators=(",", ":"))
-                            + "\n")
-                f.flush()
-                os.fsync(f.fileno())
+        with open(tmp, "w") as f:
+            for row in snapshot:
+                f.write(json.dumps(row, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        with self._lock:
             base = os.path.join(self.root, "base.jsonl")
             os.replace(tmp, base)
             # base content changed identity: re-read from 0 next
             # refresh
             self._offsets.pop(base, None)
             self._read_new_lines(base)
-            with self._io_lock:
-                # close AND unlink under one _io_lock hold: releasing
-                # between them lets a racing _append reopen the path,
-                # and the unlink would then strand that fd on an
-                # unlinked inode silently swallowing every later row
-                if self._seg_fd is not None:
-                    os.close(self._seg_fd)
-                    self._seg_fd = None
+            if old is not None:
+                # every rotated row is now in the base (the snapshot
+                # was taken after the rotation): safe to drop
                 try:
-                    os.unlink(self._seg_path)
+                    os.unlink(old)
                 except OSError:
                     pass
-            self._offsets.pop(self._seg_path, None)
+                self._offsets.pop(old, None)
             return len(self._rows)
 
     def close(self) -> None:
