@@ -2733,6 +2733,358 @@ def failover_main() -> None:
         sys.exit(1)
 
 
+def serve_sharded_main() -> None:
+    """`bench.py --serve-sharded`: the sharded front tier bench
+    (ISSUE 17, docs/SERVING.md "Sharded front tier").
+
+    Phase 1 — scaling: an in-process Router consistent-hash routes
+    sessions (one per distinct space signature) onto K real `ut serve
+    --durable` shard subprocesses over localhost TCP, for K walked up
+    via the `scale` op; aggregate asks/s is RECORDED per K (never
+    gated — on this 1-core CI box K cold shards share one core and
+    the co-tenant-noise rule applies; the artifact's value is the
+    curve on real multi-core boxes).
+
+    Phase 2 — the kill: with the full tier serving auto-resume
+    clients mid-stream, a `route.kill` fault schedule (obs/faults.py)
+    makes the router's supervisor SIGKILL its lowest-index shard on
+    an exact tick.  The supervisor respawns it on the SAME port with
+    the SAME checkpoint dir; `ut serve --durable` recovery replays
+    its sessions and the clients reconnect with backoff, re-attach by
+    durable id, and replay their idempotent frontier.  Asserted: a
+    single deterministic kill and respawn happened, zero acked
+    committed version was lost (monotone resume), every session
+    finished, and each final state — best config bit-for-bit, qor,
+    version — equals an uninterrupted matched-seed LocalSession run
+    (the parity replays run under the STRICT trace guard: one trace
+    per engine program per group, no retrace churn).
+
+    Writes BENCH_SERVE_SHARDED.json (.quick.json for --quick)."""
+    quick = "--quick" in sys.argv
+    from uptune_tpu.utils.platform_guard import force_cpu
+    force_cpu(1)
+    import jax  # noqa: F401  (backend must init after force_cpu)
+
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from uptune_tpu.analysis.lock_guard import lock_guard_from_env
+    from uptune_tpu.analysis.trace_guard import TraceGuard
+    from uptune_tpu.api.session import reset_settings
+    from uptune_tpu.exec.space_io import records_from_space
+    from uptune_tpu.obs import faults
+    from uptune_tpu.serve import connect
+    from uptune_tpu.serve.router import HashRing, Router, routing_key
+    from uptune_tpu.serve.session import LocalSession
+    from uptune_tpu.workloads import rosenbrock_space
+
+    reset_settings()
+    # UT_LOCK_GUARD: sanitize the whole bench — the router, its
+    # supervisor, the embedded hub, every client thread.  Shard
+    # subprocesses install nothing (their own planes are lint-clean)
+    lockg = lock_guard_from_env(name="sharded-bench").install()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="ut_sharded_bench_")
+    result: dict = {"metric": "serve_sharded", "quick": quick,
+                    "nproc": os.cpu_count()}
+    dims = 2
+    n_spaces = 3 if quick else 6
+    epochs = 2 if quick else 4
+    chunk = 8
+    k_steps = [1, 2] if quick else [1, 2, 3]
+    k_max = k_steps[-1]
+
+    def measure(cfg):
+        x = np.array([cfg[f"x{i}"] for i in range(dims)])
+        return float(np.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                            + (1 - x[:-1]) ** 2))
+
+    # distinct spaces = distinct routing keys = cross-shard spread.
+    # Shard names are deterministic (s0..s{K-1}), so placement is a
+    # pure function of the space bounds: walk a deterministic offset
+    # until the kill victim (s0, the lowest index) owns SOME but not
+    # ALL sessions at K_max — the kill must hit real tenants AND
+    # leave unaffected tenants to prove isolation
+    ring = HashRing()
+    for i in range(k_max):
+        ring.add(f"s{i}")
+    spaces, records, owners = [], [], []
+    for o in range(64):
+        spaces = [rosenbrock_space(dims, -3.0 - i - o * 0.125,
+                                   3.0 + i + o * 0.125)
+                  for i in range(n_spaces)]
+        records = [records_from_space(sp) for sp in spaces]
+        owners = [ring.lookup(routing_key(r)) for r in records]
+        if (len(set(owners)) == k_max
+                and 1 <= owners.count("s0") < n_spaces):
+            break
+    result["placement"] = {"owners": owners, "offset_steps": o}
+
+    store_dir = os.path.join(workdir, "store")
+    router = Router(host="127.0.0.1", port=0, shards=0,
+                    slots=4, max_sessions=n_spaces * 2 + 8,
+                    store_dir=store_dir, work_dir=workdir,
+                    supervise_interval=0.5)
+    router.start()
+
+    per_sess: dict = {}
+    errors: list = []
+    lock = threading.Lock()
+
+    def drive(idx, seed, tag, n_epochs, hold_ev=None):
+        """One auto-resume client driving one session to `n_epochs`
+        committed versions through the router (open is redirected to
+        the owning shard; everything after runs shard-direct).  With
+        `hold_ev`, a session placed on the kill victim pauses AFTER
+        its first committed epoch until the kill has fired — the
+        deterministic mid-stream guarantee: committed state exists
+        when the shard dies, later epochs happen across the resume."""
+        try:
+            c = connect(("127.0.0.1", router.port), timeout=120,
+                        auto_resume=True, max_retries=80,
+                        backoff_base=0.25, backoff_max=2.0)
+            h = c.open_session(records[idx], seed=seed,
+                               program=f"sharded-{idx}")
+            memo: dict = {}
+            asks = 0
+            acked_committed = 0
+            resume_floor_ok = True
+            stop_at = time.time() + 600
+            while h.version < n_epochs:
+                if time.time() > stop_at:
+                    raise RuntimeError(
+                        f"{tag}/{idx} wedged at v{h.version}")
+                if hold_ev is not None and owners[idx] == "s0" \
+                        and h.version >= 1:
+                    hold_ev.wait(timeout=300)
+                trials = h.ask(chunk)
+                if not trials:
+                    continue
+                asks += len(trials)
+                res = []
+                for t in trials:
+                    key = json.dumps(t.config, sort_keys=True)
+                    if key not in memo:
+                        memo[key] = measure(t.config)
+                    res.append((t.ticket, memo[key]))
+                r = h.tell_many(res)
+                # the zero-committed-loss contract, client-observed
+                # (the failover bench rule): an acked committed
+                # version may never regress after a resume
+                v = r.get("version")
+                if v is not None:
+                    if int(v) < acked_committed:
+                        resume_floor_ok = False
+                    if r.get("committed"):
+                        acked_committed = max(acked_committed, int(v))
+            best = h.best()
+            with lock:
+                per_sess[(tag, idx)] = {
+                    "best": best, "asks": asks,
+                    "acked_committed": acked_committed,
+                    "monotone": resume_floor_ok,
+                    "reconnects": c.reconnects,
+                    "redirects": c.redirects,
+                    "shard": f"{c.host}:{c.port}"}
+            h.close()
+            c.close()
+        except Exception as e:   # surfaced below
+            with lock:
+                errors.append((tag, idx, repr(e)))
+
+    def run_round(tag, seed_base, n_epochs, mid_round=None,
+                  hold_ev=None):
+        threads = [threading.Thread(
+            target=drive, args=(i, seed_base + i, tag, n_epochs,
+                                hold_ev))
+                   for i in range(n_spaces)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        if mid_round is not None:
+            mid_round()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        assert not errors, errors
+        asks = sum(per_sess[(tag, i)]["asks"]
+                   for i in range(n_spaces))
+        return asks / wall, wall
+
+    try:
+        # ---- phase 1: aggregate asks/s vs K --------------------------
+        rates = {}
+        for ki, k in enumerate(k_steps):
+            r = router.handle({"op": "scale", "shards": k})
+            assert r["ok"] and r["live"] == k, r
+            rate, wall = run_round(f"k{k}", 5000 + 1000 * ki, epochs)
+            rates[str(k)] = round(rate, 1)
+            print(f"bench --serve-sharded: K={k} agg "
+                  f"{rate:.1f} asks/s ({wall:.1f}s)", file=sys.stderr)
+        ks = [rates[str(k)] for k in k_steps]
+        result["phase1"] = {
+            "sessions": n_spaces, "epochs": epochs,
+            "k_steps": k_steps, "agg_asks_per_s": rates,
+            # recorded, NOT gated: K shards share one core here
+            "monotone_recorded": all(b >= a for a, b
+                                     in zip(ks, ks[1:])),
+        }
+
+        # ---- phase 2: the deterministic kill -------------------------
+        # every session opens and commits its first epoch; sessions on
+        # the victim then HOLD (see drive) while route.kill is armed —
+        # the supervisor SIGKILLs shard s0 on its next tick, the hold
+        # releases, and the held sessions drive their remaining epochs
+        # across the respawn through auto-resume
+        epochs_kill = epochs + 2
+        scrape = {}
+        kill_seen = threading.Event()
+        mapped0 = router.handle({"op": "ping"})["sessions"]
+
+        def mid_round():
+            # wait until every phase-2 session is mapped (all opens
+            # done) before arming, so the kill can't race an open
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                st = router.handle({"op": "ping"})
+                if st.get("sessions", 0) >= mapped0 + n_spaces:
+                    break
+                time.sleep(0.1)
+            faults.arm("route.kill", "error",
+                       at=faults.hits("route.kill") + 1)
+            deadline = time.time() + 60
+            while time.time() < deadline and router.kills < 1:
+                time.sleep(0.1)
+            kill_seen.set()     # release the held victims
+            # mid-drive fleet scrape for the artifact: the router's
+            # metrics op re-serves the hub rollup in the `ut top`
+            # shape, population gauges summed across shards
+            deadline = time.time() + 20
+            m = {}
+            while time.time() < deadline:
+                m = router.handle({"op": "metrics"})
+                if m.get("sessions"):
+                    break
+                time.sleep(0.5)
+            scrape.update({"sessions": m.get("sessions"),
+                           "shards": m.get("shards"),
+                           "sources": m.get("sources")})
+
+        rate, wall = run_round("kill", 6000, epochs_kill,
+                               mid_round=mid_round, hold_ev=kill_seen)
+        faults.disarm()
+        stats = router.handle({"op": "stats"})
+        assert stats["ok"], stats
+        result["fleet_scrape_mid_drive"] = scrape
+
+        # uninterrupted matched-seed baselines: bitwise state parity.
+        # STRICT trace guard: each space compiles its own engine
+        # group, and the guard counts group 2..N's wrappers as
+        # "rebuilt after trace" against the BASE label — so the
+        # strict budget is n_spaces (the backstop for gross churn);
+        # the EXACT gate is guard_ok below: every wrapper label
+        # traced exactly once, three programs, n_spaces each
+        parity = []
+        with TraceGuard(limit=n_spaces, strict=True,
+                        name="sharded-parity") as tg:
+            for i in range(n_spaces):
+                ls = LocalSession(spaces[i], seed=6000 + i)
+                try:
+                    while ls.version < epochs_kill:
+                        for t in ls.ask(chunk):
+                            ls.tell(t.ticket, measure(t.config))
+                    want = ls.best()
+                finally:
+                    ls.close()
+                got = per_sess[("kill", i)]["best"]
+                parity.append({
+                    "space": i, "owner": owners[i],
+                    "config_equal": got["config"] == want["config"],
+                    "qor_equal": got["qor"] == want["qor"],
+                    "version_equal": got["version"] == want["version"]
+                                     == epochs_kill,
+                })
+        guard_counts = {k: v for k, v in tg.counts.items()
+                        if "Engine" in k}
+        # fold the #N wrapper suffixes back to base programs: three
+        # engine programs, each traced once per space's group
+        guard_base: dict = {}
+        for k, v in guard_counts.items():
+            b = k.split("#")[0]
+            guard_base[b] = guard_base.get(b, 0) + v
+        parity_ok = all(p["config_equal"] and p["qor_equal"]
+                        and p["version_equal"] for p in parity)
+        monotone_ok = all(per_sess[("kill", i)]["monotone"]
+                          for i in range(n_spaces))
+        loss_ok = all(per_sess[("kill", i)]["best"]["version"]
+                      >= per_sess[("kill", i)]["acked_committed"]
+                      for i in range(n_spaces))
+        guard_ok = (len(guard_base) == 3
+                    and all(v == n_spaces
+                            for v in guard_base.values())
+                    and all(v == 1 for v in guard_counts.values()))
+        # the kill must have hit live tenants: every session routed to
+        # s0 reconnected at least once
+        affected = [i for i in range(n_spaces) if owners[i] == "s0"]
+        resumed_ok = all(per_sess[("kill", i)]["reconnects"] > 0
+                         for i in affected)
+        kills = int(stats.get("kills", 0))
+        restarts = int(stats.get("restarts", 0))
+        result["phase2"] = {
+            "sessions": n_spaces, "epochs": epochs_kill,
+            "agg_asks_per_s": round(rate, 1),
+            "kills": kills, "restarts": restarts,
+            "victim": "s0", "affected_sessions": affected,
+            "client_reconnects": {
+                str(i): per_sess[("kill", i)]["reconnects"]
+                for i in range(n_spaces)},
+            "client_redirects": {
+                str(i): per_sess[("kill", i)]["redirects"]
+                for i in range(n_spaces)},
+            "parity": parity, "parity_bitwise_ok": parity_ok,
+            "acked_committed_monotone": monotone_ok,
+            "zero_committed_loss": loss_ok,
+            "kill_wall_s": round(wall, 2),
+            "trace_guard": {"strict": True, "counts": guard_counts,
+                            "programs": guard_base,
+                            "clean": guard_ok},
+            "shards": stats.get("shards"),
+        }
+        print(f"bench --serve-sharded: kill/resume parity "
+              f"{'OK' if parity_ok else 'FAILED'} (kills={kills}, "
+              f"restarts={restarts}, affected={affected})",
+              file=sys.stderr)
+    finally:
+        faults.disarm()
+        router.stop()
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    lockg.uninstall()
+    if lockg.enabled:
+        result["lock_sanitizer"] = lockg.report()
+        lockg.check()   # strict: raise on any lock-order cycle
+    ok = (parity_ok and monotone_ok and loss_ok and guard_ok
+          and resumed_ok and len(affected) >= 1
+          and kills == 1 and restarts >= 1)
+    result["ok"] = ok
+    name = ("BENCH_SERVE_SHARDED.quick.json" if quick
+            else "BENCH_SERVE_SHARDED.json")
+    path = os.path.join(repo, name)
+    with open(path, "w") as f:
+        json.dump({**result, "captured_unix": time.time()}, f, indent=1)
+    print(f"bench: sharded-serving evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps({"metric": "serve_sharded_ok", "value": ok,
+                      "agg_asks_per_s": result["phase1"]
+                                              ["agg_asks_per_s"],
+                      "kills": kills, "quick": quick}))
+    if not ok:
+        sys.exit(1)
+
+
 def main() -> None:
     if "--obs" in sys.argv:
         obs_main()
@@ -2760,6 +3112,9 @@ def main() -> None:
         return
     if "--failover" in sys.argv:
         failover_main()
+        return
+    if "--serve-sharded" in sys.argv:
+        serve_sharded_main()
         return
     if "--serve" in sys.argv:
         serve_main()

@@ -52,9 +52,13 @@ fi
 # multi-tenant serving plane (ISSUE 8 — a silenced retrace or
 # host-sync hazard there stalls EVERY tenant at once; since
 # ISSUE 14 serve/wire.py is the service kernel EVERY wire-speaking
-# plane runs on, and since ISSUE 15 serve/durable.py is the
-# write-ahead checkpoint plane the zero-committed-loss contract
-# rests on) get
+# plane runs on — rebuilt in ISSUE 17 as a single asyncio event
+# loop whose handlers run on a bounded worker pool, so a lock held
+# across a blocking call now stalls the whole connection plane, not
+# one thread — since ISSUE 15 serve/durable.py is the write-ahead
+# checkpoint plane the zero-committed-loss contract rests on, and
+# since ISSUE 17 serve/router.py is the sharded front tier whose
+# supervisor thread + session map sit in front of every shard) get
 # no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys

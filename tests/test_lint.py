@@ -647,9 +647,13 @@ def test_package_suppression_free(package):
     compiled programs (ISSUE 8) — a silenced retrace or host-sync
     hazard there stalls ALL sessions at once, since ISSUE 14 its
     wire.py service kernel carries EVERY wire-speaking plane (session
-    server + telemetry hub), and since ISSUE 15 its durable.py
-    write-ahead checkpoint plane carries the zero-committed-loss
-    contract.  lint.sh enforces the same in the
+    server + telemetry hub) — rebuilt in ISSUE 17 as one asyncio
+    event loop over a bounded worker pool, where a lock held across a
+    blocking call stalls the whole connection plane — since ISSUE 15
+    its durable.py write-ahead checkpoint plane carries the
+    zero-committed-loss contract, and since ISSUE 17 its router.py
+    sharded front tier (supervisor thread + session map) fronts every
+    shard.  lint.sh enforces the same in the
     pre-commit gate."""
     r = subprocess.run(
         [sys.executable, "-m", "uptune_tpu.analysis",

@@ -6,6 +6,7 @@ layout contract on the virtual 8-device CPU platform.  TestTwoProcess
 actual `jax.distributed` processes, builds the hybrid mesh, runs sharded
 engine steps with the cross-host best-exchange collective, and asserts
 both processes computed the same global best."""
+import json
 import os
 import socket
 import subprocess
@@ -272,6 +273,95 @@ class TestLauncher:
         out = capsys.readouterr().out
         assert rc == 0
         assert "[h0]" not in out and "PureRandom" in out
+
+
+class TestTwoProcessLoopback:
+    """The wire-kernel loopback sibling of TestTwoProcess (ISSUE 17):
+    the jax builds on this box may not implement CPU multi-process
+    collectives, which skips the real DCN cases above — this covers
+    the two-process wiring that IS this repo's code (serve/wire.py
+    asyncio kernel + serve/router.py consistent-hash placement) over
+    real localhost TCP with zero jax in the workers, so it runs in
+    tier-1 unconditionally."""
+
+    N_KEYS = 48
+
+    @staticmethod
+    def _req(port, payload):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=10) as s:
+            f = s.makefile("rwb")
+            f.write((json.dumps(payload) + "\n").encode())
+            f.flush()
+            return json.loads(f.readline())
+
+    def test_routed_tells_across_two_workers(self, tmp_path):
+        from uptune_tpu.utils.pypath import child_pythonpath
+        worker = os.path.join(os.path.dirname(__file__),
+                              "wire_worker.py")
+        env = dict(os.environ, PYTHONPATH=child_pythonpath())
+        procs = [subprocess.Popen(
+            [sys.executable, worker], stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+            for _ in range(2)]
+        from uptune_tpu.serve.router import Router
+        router = None
+        try:
+            ports = []
+            for p in procs:
+                line = p.stdout.readline().strip()
+                assert line.startswith("PORT "), line
+                ports.append(int(line.split()[1]))
+            router = Router(shards=0, work_dir=str(tmp_path),
+                            supervise_interval=30.0).start()
+            by_name = {}
+            for port in ports:
+                by_name[router.register("127.0.0.1", port)] = port
+
+            # route every key through the router's own TCP port, tell
+            # its qor to the owning worker, and re-look-up afterwards:
+            # placement must be a pure function of the key
+            qors = {f"loop-{i}": ((i * 37) % 101) / 10.0
+                    for i in range(self.N_KEYS)}
+            owners = {}
+            for key, qor in qors.items():
+                r = self._req(router.port, {"op": "route", "key": key})
+                assert r["ok"], r
+                owners[key] = r["shard"]
+                t = self._req(by_name[r["shard"]],
+                              {"op": "tell", "qor": qor})
+                assert t["ok"], t
+            for key in qors:
+                r = self._req(router.port, {"op": "route", "key": key})
+                assert r["shard"] == owners[key]
+
+            # both workers took real traffic, nothing was lost, and
+            # the per-worker minima compose to the global minimum
+            assert len(set(owners.values())) == 2, owners
+            bests = {}
+            tells = 0
+            for name, port in by_name.items():
+                b = self._req(port, {"op": "best"})
+                tells += b["tells"]
+                bests[name] = b["best"]
+            assert tells == self.N_KEYS
+            for name in by_name:
+                want = min(q for k, q in qors.items()
+                           if owners[k] == name)
+                assert bests[name] == want
+            assert min(bests.values()) == min(qors.values())
+        finally:
+            if router is not None:
+                router.stop()
+            for p in procs:
+                if p.stdin:
+                    p.stdin.close()     # the worker's exit signal
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
 
 @pytest.mark.slow

@@ -1,0 +1,49 @@
+"""Jax-free wire-kernel worker process for the loopback multi-process
+tests (tests/test_multihost.py TestTwoProcessLoopback, ISSUE 17).
+
+Speaks the repo wire protocol over real localhost TCP through the
+asyncio wire kernel (serve/wire.py) without importing the engine: a
+stand-in "host" holding a local best that the parent test routes work
+onto through the consistent-hash Router.  Prints ``PORT <n>`` once
+listening; exits when its stdin closes (the parent's teardown signal —
+no signal races, no orphan on parent death)."""
+import sys
+
+
+def main() -> int:
+    from uptune_tpu.serve.wire import WireServer
+
+    class Worker(WireServer):
+        WIRE_NAME = "ut-mh-worker"
+
+        def __init__(self) -> None:
+            super().__init__("127.0.0.1", 0)
+            self.best = None
+            self.tells = 0
+
+        def _op_ping(self, req: dict) -> dict:
+            return {"role": "loopback-worker"}
+
+        def _op_tell(self, req: dict) -> dict:
+            qor = float(req["qor"])
+            with self._lock:
+                self.tells += 1
+                if self.best is None or qor < self.best:
+                    self.best = qor
+                return {"best": self.best, "tells": self.tells}
+
+        def _op_best(self, req: dict) -> dict:
+            with self._lock:
+                return {"best": self.best, "tells": self.tells}
+
+        _OPS = {"ping": _op_ping, "tell": _op_tell, "best": _op_best}
+
+    w = Worker().start()
+    print(f"PORT {w.port}", flush=True)
+    sys.stdin.read()            # parent closes stdin to stop us
+    w.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

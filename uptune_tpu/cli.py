@@ -378,33 +378,35 @@ def _merge_replica_bests(cleaned: List[str], n: int,
           f"(replica h{pid}) -> {dst}")
 
 
+# `ut <name> ...` subcommands, each deferring to its own module (and
+# flag set) — one table so dispatch, the misplaced-subcommand hint and
+# future additions stay in lockstep:
+#   serve   the tuning-as-a-service session server (docs/SERVING.md)
+#   route   the sharded front tier: consistent-hash router over K
+#           shard processes (docs/SERVING.md "Sharded front tier")
+#   top     live terminal dashboard over a running server/router or a
+#           flight-recorder metrics JSONL (docs/OBSERVABILITY.md)
+#   report  render a tuning journal into a search-quality report
+#   hub     the fleet-telemetry collector --telemetry ships to
+SUBCOMMANDS = {
+    "serve": ("uptune_tpu.serve.cli", "main"),
+    "route": ("uptune_tpu.serve.router", "main"),
+    "top": ("uptune_tpu.obs.top", "main"),
+    "report": ("uptune_tpu.obs.report", "main"),
+    "hub": ("uptune_tpu.obs.hub", "main"),
+}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     raw = list(argv if argv is not None else sys.argv[1:])
-    if raw and raw[0] == "serve":
-        # `ut serve ...`: the tuning-as-a-service session server
-        # (docs/SERVING.md) has its own flag set and precedence layer
-        from .serve.cli import main as serve_main
-        return serve_main(raw[1:])
-    if raw and raw[0] == "top":
-        # `ut top ...`: live terminal dashboard over a running server
-        # (or a flight-recorder metrics JSONL) — docs/OBSERVABILITY.md
-        from .obs.top import main as top_main
-        return top_main(raw[1:])
-    if raw and raw[0] == "report":
-        # `ut report ...`: render a tuning journal into a search-
-        # quality report (docs/OBSERVABILITY.md "Search-quality
-        # telemetry")
-        from .obs.report import main as report_main
-        return report_main(raw[1:])
-    if raw and raw[0] == "hub":
-        # `ut hub ...`: the fleet-telemetry collector every
-        # --telemetry process ships to (docs/OBSERVABILITY.md
-        # "Fleet telemetry")
-        from .obs.hub import main as hub_main
-        return hub_main(raw[1:])
+    if raw and raw[0] in SUBCOMMANDS:
+        import importlib
+        mod_name, attr = SUBCOMMANDS[raw[0]]
+        sub_main = getattr(importlib.import_module(mod_name), attr)
+        return sub_main(raw[1:])
     first_pos = next((a for a in raw if not a.startswith("-")), None) \
         if raw and raw[0].startswith("-") else None
-    if first_pos in ("serve", "top", "report", "hub"):
+    if first_pos in SUBCOMMANDS:
         # `ut -v serve` / `ut -v top` fall through and try to TUNE a
         # program file literally named like the subcommand.  A hint
         # only — never abort: the word may legitimately be a flag

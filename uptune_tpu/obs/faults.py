@@ -26,6 +26,12 @@ Points (the seams future shard-failover work reuses):
   commit-vs-checkpoint window the bounded-loss contract is about
 * ``store.record`` — a trial row is about to be recorded
 * ``pool.reap``    — a worker-pool build is about to be reaped
+* ``route.spawn``  — the front-tier router is about to spawn (or
+  respawn) a shard process (serve/router.py)
+* ``route.kill``   — fired once per router supervisor tick; arming it
+  with ``error`` makes the supervisor SIGKILL its lowest-index live
+  shard on that exact tick — the deterministic shard-death injection
+  ``bench.py --serve-sharded`` replays on every box
 
 Actions: ``crash`` (``os._exit`` — no atexit, no flush: the closest
 in-process stand-in for SIGKILL), ``delay`` (sleep `param` seconds),
@@ -52,7 +58,7 @@ __all__ = ["FaultInjected", "POINTS", "ACTIONS", "armed", "arm",
 ENV_VAR = "UT_FAULTS"
 
 POINTS = ("wire.accept", "wire.read", "wire.reply", "ckpt.append",
-          "store.record", "pool.reap")
+          "store.record", "pool.reap", "route.spawn", "route.kill")
 
 ACTIONS = ("crash", "delay", "error")
 

@@ -437,6 +437,24 @@ class TelemetryHub(WireServer):
                 "truncated": len(rows) > limit,
                 "health": rows[:limit]}
 
+    def gauge_values(self, key: str) -> List[float]:
+        """One value per LIVE (non-final) source's latest window for
+        gauge `key` — the additive-rollup seam: fleet_rollup's gauges
+        are last-write-wins (correct for a fleet-wide setting like a
+        snapshot version), but a per-process population gauge like
+        ``serve.sessions.active`` only means something fleet-wide as a
+        SUM, so the front-tier router re-aggregates those few keys
+        from the per-source values (serve/router.py metrics op)."""
+        out: List[float] = []
+        with self._lock:
+            for s in self._sources.values():
+                if s.final_seen or not isinstance(s.last_window, dict):
+                    continue
+                v = (s.last_window.get("gauges") or {}).get(key)
+                if isinstance(v, (int, float)):
+                    out.append(float(v))
+        return out
+
     def _op_stats(self, req: dict) -> dict:
         with self._lock:
             return {"sources": len(self._sources),

@@ -49,6 +49,9 @@ _LAZY = {
     "SessionServer": ("uptune_tpu.serve.server", "SessionServer"),
     "RequestError": ("uptune_tpu.serve.wire", "RequestError"),
     "WireServer": ("uptune_tpu.serve.wire", "WireServer"),
+    "Router": ("uptune_tpu.serve.router", "Router"),
+    "HashRing": ("uptune_tpu.serve.router", "HashRing"),
+    "routing_key": ("uptune_tpu.serve.router", "routing_key"),
 }
 
 __all__ = sorted(_LAZY)

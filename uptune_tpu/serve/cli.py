@@ -62,6 +62,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "tells additionally survive power loss "
                         "(SIGKILL durability needs no fsync; default: "
                         "ut.config serve-durable-fsync)")
+    p.add_argument("--workers", type=int, default=None, metavar="N",
+                   help="wire-kernel worker-pool width: how many "
+                        "requests may execute concurrently across "
+                        "ALL connections (default 8).  The asyncio "
+                        "connection loop itself is single-threaded; "
+                        "workers are where commits and checkpoint "
+                        "appends run")
     p.add_argument("--orphan-ttl", type=float, default=None,
                    metavar="SECONDS",
                    help="grace a disconnected durable tenant gets "
@@ -196,6 +203,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from .server import SessionServer
     srv = SessionServer(**resolve_config(args))
+    if args.workers is not None and args.workers > 0:
+        srv.max_workers = int(args.workers)
 
     # fleet telemetry (docs/OBSERVABILITY.md "Fleet telemetry"): flag
     # > UT_TELEMETRY env > ut.config('telemetry').  The serving

@@ -94,6 +94,11 @@ def connect(addr: Union[str, tuple, None] = None,
 
 
 class SessionClient:
+    # bound on {"redirect": ...} hops one request may follow — a
+    # misconfigured router pair bouncing a key between themselves
+    # must surface as an error, not an infinite reconnect loop
+    MAX_REDIRECTS = 4
+
     def __init__(self, host: str, port: int, timeout: float = 60.0,
                  *, op_timeout: Optional[float] = None,
                  auto_resume: bool = False, max_retries: int = 8,
@@ -123,6 +128,12 @@ class SessionClient:
         # connection owns them server-side
         self._resume_ids: List[str] = []
         self.reconnects = 0
+        # redirect hops followed (the sharded front tier, ISSUE 17):
+        # a router answers open/attach with {"redirect": "host:port"}
+        # and the client re-homes the whole connection onto the
+        # owning shard — steady-state ask/tell never crosses the
+        # router again
+        self.redirects = 0
         self._connect()
 
     # -- wire ----------------------------------------------------------
@@ -235,15 +246,24 @@ class SessionClient:
         reconnect with exponential backoff+jitter, re-attach every
         session this client owns, and replay the request with its
         idempotency tags (a replayed ``ask`` adds ``reissue`` so
-        already-issued tickets are re-offered, never re-minted)."""
+        already-issued tickets are re-offered, never re-minted).
+
+        A reply carrying ``redirect: "host:port"`` (the sharded front
+        tier's open/attach answer) re-homes this client: the
+        connection moves to the owning shard and the request is
+        re-sent there, bounded by ``MAX_REDIRECTS`` hops.  Because
+        ``self.host``/``self.port`` move too, every later reconnect —
+        including auto-resume after a shard death — targets the shard
+        directly, never the router."""
         payload = {"op": op, **{k: v for k, v in fields.items()
                                 if v is not None}}
         attempt = 0
+        hops = 0
         backoff = self.backoff_base
         while True:
             try:
                 if self._broken or self._f is None:
-                    if not self.auto_resume:
+                    if not self.auto_resume and hops == 0:
                         raise ConnectionLostError(
                             "connection desynced by an interrupted "
                             "request; reconnect")
@@ -261,7 +281,25 @@ class SessionClient:
                             self._reattach()
                     if payload.get("op") == "ask":
                         payload["reissue"] = True
-                return self._exchange(payload)
+                resp = self._exchange(payload)
+                target = resp.get("redirect")
+                if isinstance(target, str) and target:
+                    if hops >= self.MAX_REDIRECTS:
+                        raise ServeError(
+                            f"redirect limit ({self.MAX_REDIRECTS}) "
+                            f"exceeded following {target!r}")
+                    hops += 1
+                    self.redirects += 1
+                    host, _, port = target.rpartition(":")
+                    self.host, self.port = (host or self.host,
+                                            int(port))
+                    # drop the old connection; the reconnect branch
+                    # above re-dials the NEW address (and re-attaches
+                    # any sessions this client already owns there)
+                    with self._lock:
+                        self._drop_conn()
+                    continue
+                return resp
             except (ConnectionLostError, OSError) as e:
                 attempt += 1
                 self._broken = True

@@ -602,6 +602,12 @@ class SessionServer(WireServer):
                       for k, s in self._stores.items()}
         out = {"sessions": self.n_sessions, "groups": groups,
                "stores": stores, "store_dir": self.store_dir}
+        if req.get("sessions"):
+            # the front-tier router's attach probe (serve/router.py):
+            # an id the router no longer remembers is located by
+            # asking each shard which durable sessions it owns
+            with self._lock:
+                out["session_ids"] = sorted(self._sessions)
         if self.ckpt is not None:
             self._sweep_orphans()
             with self._lock:

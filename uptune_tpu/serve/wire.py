@@ -2,11 +2,12 @@
 every wire-speaking plane in the repo.
 
 PR 8's session server, the fleet-telemetry hub (obs/hub.py, ISSUE 14),
-and the planes ROADMAP items 1-2 specify against this seam (the
-sharded front tier, a remote ResultStore server) all speak the same
-protocol: one JSON object per line, each carrying an ``op`` field,
-answered by one JSON object per line.  This module owns the generic
-half so each service only writes its op table:
+the sharded front-tier router (serve/router.py, ISSUE 17) and the
+planes ROADMAP item 2 specifies against this seam (a remote
+ResultStore server) all speak the same protocol: one JSON object per
+line, each carrying an ``op`` field, answered by one JSON object per
+line.  This module owns the generic half so each service only writes
+its op table:
 
 * **Dispatch** — a class-level ``_OPS`` table maps op names to
   handler methods; ``handle(request) -> response`` is transport-free
@@ -18,16 +19,33 @@ half so each service only writes its op table:
   so clients may pipeline; an optional ``ctx`` span id is recorded as
   the handler span's ``parent`` so `ut-trace merge` joins
   client/server shards (docs/OBSERVABILITY.md).
-* **Connection lifecycle** — thread-per-connection reader/writer
-  loops around ``handle()``, with per-connection state hooks
-  (``_conn_opened`` / ``_on_response`` / ``_conn_closed``) so a
-  service can scope resources to the connection that created them
-  and reap them when it dies — the session server's crashed-tenant
-  slot reaping and the hub's source liveness both ride this seam.
+* **Connection plane** — since ISSUE 17 a single asyncio event loop
+  (one ``-loop`` thread) owns accept + read + write for EVERY
+  connection, replacing the thread-per-connection loops whose GIL
+  handoffs were the ~1.7k asks/s ceiling (ROADMAP item 1): the loop
+  never runs handler code — each parsed request is dispatched onto a
+  BOUNDED worker pool (``max_workers``), so one slow commit stalls
+  one worker, never the loop, and ten thousand idle tenants cost ten
+  thousand coroutines instead of ten thousand threads.  Requests on
+  one connection still complete in order (the coroutine awaits each
+  dispatch), so per-connection semantics are exactly the old ones.
+* **Per-connection state hooks** (``_conn_opened`` / ``_on_response``
+  / ``_conn_closed``) let a service scope resources to the connection
+  that created them and reap them when it dies — the session server's
+  crashed-tenant slot reaping and the hub's source liveness both ride
+  this seam, unchanged across the event-loop rewrite.
+* **Hardening** — ``max_line`` caps one request line (one error
+  reply, then close: the unread stream cannot be re-synchronized);
+  ``idle_timeout`` bounds how long a silent connection may pin its
+  coroutine.  Generous by default because serve tenants legitimately
+  idle across external builds — instances may override either before
+  ``start()``.
 * **Reaping and shutdown** — dead connections prune themselves from
   the registry (long-lived servers stay bounded by LIVE connections
-  under churn); ``stop()`` closes the listener and every tracked
-  connection under the lock.
+  under churn); ``stop()`` is a real barrier: the loop closes the
+  listener and every connection, conn coroutines run their close
+  hooks, and the loop thread is joined (bounded) so no handler races
+  interpreter teardown writing to closed sockets.
 
 Subclass contract::
 
@@ -38,17 +56,19 @@ Subclass contract::
 
 ``HANDLE_SPAN`` stays ``serve.handle`` for every service: the trace
 merge tool joins ``client.request`` spans against that name, and a
-hub or store server is as much a serving plane as the session server.
+hub or router is as much a serving plane as the session server.
 """
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import socket
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Set
 
 from .. import obs
 from ..obs import faults
@@ -71,27 +91,31 @@ class WireServer:
     HANDLE_SPAN = "serve.handle"
     _OPS: Dict[str, Callable[..., dict]] = {}
 
-    # connection hardening (ISSUE 15 satellite).  MAX_LINE caps one
-    # request line: a client streaming an unterminated megarequest
-    # gets one error reply and a close instead of growing a buffer
-    # forever.  IDLE_TIMEOUT bounds how long a silent connection may
-    # pin its reader thread (a client that connects and sends nothing
-    # used to hold it until server stop); generous by default because
-    # serve tenants legitimately idle across external builds —
-    # instances may override either before start()
+    # connection hardening (ISSUE 15 satellite) — see module docstring
     MAX_LINE = 1 << 20
     IDLE_TIMEOUT = 1800.0
+    # handler-pool bound (ISSUE 17): how many requests may execute
+    # concurrently across ALL connections.  The pool is where blocking
+    # handler work (group commits, checkpoint fsyncs, timeline
+    # appends) lands so the event loop stays pure I/O; more workers
+    # than cores only adds GIL pressure on this box
+    MAX_WORKERS = 8
 
     def __init__(self, host: str, port: int):
         self.host = str(host)
         self.port = int(port)
         self.max_line = int(self.MAX_LINE)
         self.idle_timeout: Optional[float] = self.IDLE_TIMEOUT
+        self.max_workers = int(self.MAX_WORKERS)
         self._lock = threading.RLock()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
         self._conns: List[socket.socket] = []
         self._running = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._stop_ev: Optional[asyncio.Event] = None
+        self._tasks: Set[asyncio.Task] = set()   # loop-thread only
         self.started_unix = time.time()
 
     # -- per-connection hooks ------------------------------------------
@@ -104,7 +128,9 @@ class WireServer:
 
     def _on_response(self, state: Any, req: dict, resp: dict) -> None:
         """Called after every successfully parsed request is handled
-        (bad-JSON lines never reach it)."""
+        (bad-JSON lines never reach it).  Runs on the worker pool,
+        directly after the handler, so response-ordering per
+        connection is preserved."""
 
     def _conn_closed(self, state: Any) -> None:
         """Called exactly once when the connection dies — the reaping
@@ -157,10 +183,19 @@ class WireServer:
             out["id"] = rid
         return out
 
+    def _dispatch(self, state: Any, req: dict) -> dict:
+        """One request's worker-pool job: handler + response hook
+        (the hook runs here, not on the loop, so a hook that blocks —
+        the hub's durable timeline append — costs a worker slot, not
+        the whole connection plane)."""
+        resp = self.handle(req)
+        self._on_response(state, req, resp)
+        return resp
+
     # -- TCP -----------------------------------------------------------
     def start(self) -> "WireServer":
-        """Bind + listen + accept loop in a daemon thread; .port holds
-        the bound port (useful with port=0)."""
+        """Bind + listen, then run the event loop in a daemon thread;
+        .port holds the bound port (useful with port=0)."""
         # a serving process trades a little throughput for tail
         # latency: the interpreter's default 5ms GIL switch interval
         # parks every waiting request behind CPU-bound peers (config
@@ -173,11 +208,21 @@ class WireServer:
         s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         s.bind((self.host, self.port))
         s.listen(128)
+        # the socket is listening BEFORE start() returns: a client may
+        # connect immediately (it queues in the backlog until the loop
+        # thread starts accepting), exactly like the threaded kernel
         self.port = s.getsockname()[1]
         self._listener = s
         self._running = True
-        t = threading.Thread(target=self._accept_loop,
-                             name=f"{self.WIRE_NAME}-accept",
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_workers,
+            thread_name_prefix=f"{self.WIRE_NAME}-worker")
+        self._loop = asyncio.new_event_loop()
+        # created HERE (not in the loop thread) so a stop() racing a
+        # just-started server always has an event to set
+        self._stop_ev = asyncio.Event()
+        t = threading.Thread(target=self._run_loop,
+                             name=f"{self.WIRE_NAME}-loop",
                              daemon=True)
         t.start()
         with self._lock:
@@ -186,59 +231,82 @@ class WireServer:
                  self.host, self.port, self._listen_banner())
         return self
 
-    def _accept_loop(self) -> None:
-        while self._running:
-            try:
-                conn, addr = self._listener.accept()
-            except OSError:
-                return      # listener closed
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            faults.fire("wire.accept")
-            t = threading.Thread(target=self._serve_conn,
-                                 args=(conn, addr),
-                                 name=f"{self.WIRE_NAME}-{addr[1]}",
-                                 daemon=True)
-            # both registries mutate under _lock everywhere, so
-            # stop()'s shutdown snapshot is never a torn read;
-            # _serve_conn prunes its own entries on exit, keeping a
-            # long-lived server's registries bounded by LIVE
-            # connections under open/close churn
-            with self._lock:
-                self._conns.append(conn)
-                self._threads.append(t)
-            t.start()
-
-    def _serve_conn(self, conn: socket.socket, addr) -> None:
-        if self.idle_timeout:
-            # bounded reads: a stalled/silent client times out of its
-            # reader thread instead of pinning it until server stop
-            # (the conn closes on timeout — mid-line resync is not
-            # possible on a byte stream)
-            conn.settimeout(float(self.idle_timeout))
-        f = conn.makefile("rwb")
-        state = self._conn_opened(conn, addr)
+    def _run_loop(self) -> None:
+        """The event-loop thread: owns every socket until stop()."""
+        asyncio.set_event_loop(self._loop)
         try:
-            while True:
+            self._loop.run_until_complete(self._main())
+        except Exception:       # defensive: the loop dying must be
+            # loud, never a silent half-dead server
+            log.exception("[%s] event loop failed", self.WIRE_NAME)
+        finally:
+            self._loop.close()
+
+    async def _main(self) -> None:
+        server = await asyncio.start_server(
+            self._serve_conn, sock=self._listener,
+            limit=self.max_line + 1)
+        try:
+            await self._stop_ev.wait()
+        finally:
+            server.close()
+            await server.wait_closed()
+            # cancelling a conn task unwinds it through its finally:
+            # writer closed, registry pruned, _conn_closed ran — the
+            # gather is the barrier stop() joins through
+            for task in list(self._tasks):
+                task.cancel()
+            if self._tasks:
+                await asyncio.gather(*self._tasks,
+                                     return_exceptions=True)
+            # let the transports' scheduled close callbacks run so
+            # every conn fd is really closed before the loop exits
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        conn = writer.get_extra_info("socket")
+        addr = writer.get_extra_info("peername") or ("?", 0)
+        task = asyncio.current_task()
+        self._tasks.add(task)
+        # both registries mutate under _lock everywhere, so stop()'s
+        # shutdown snapshot is never a torn read; the finally below
+        # prunes this conn's entry, keeping a long-lived server's
+        # registry bounded by LIVE connections under open/close churn
+        with self._lock:
+            self._conns.append(conn)
+        state = self._conn_opened(conn, addr)
+        loop = asyncio.get_running_loop()
+        try:
+            faults.fire("wire.accept")
+            if conn is not None:
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+            while self._running:
                 try:
-                    line = f.readline(self.max_line + 1)
-                except (TimeoutError, socket.timeout):
+                    line = await asyncio.wait_for(
+                        reader.readline(),
+                        timeout=self.idle_timeout or None)
+                except asyncio.TimeoutError:
                     obs.count("wire.idle_timeouts")
                     log.info("[%s] closing idle connection %s",
                              self.WIRE_NAME, addr)
                     break
-                if not line:
-                    break
-                if len(line) > self.max_line:
-                    # one complete error reply, then close: the rest
-                    # of the oversized line is unread, so the stream
+                except ValueError:
+                    # the stream reader's limit tripped mid-line: one
+                    # complete error reply, then close — the rest of
+                    # the oversized line is unread, so the stream
                     # cannot be re-synchronized
                     obs.count("wire.line_cap")
-                    f.write(json.dumps(
+                    writer.write(json.dumps(
                         {"ok": False,
                          "error": f"request line exceeds "
                                   f"{self.max_line} bytes"},
                         separators=(",", ":")).encode() + b"\n")
-                    f.flush()
+                    await writer.drain()
+                    break
+                if not line:
                     break
                 line = line.strip()
                 if not line:
@@ -249,18 +317,24 @@ class WireServer:
                 except json.JSONDecodeError as e:
                     resp = {"ok": False, "error": f"bad JSON: {e}"}
                 else:
-                    resp = self.handle(req)
-                    self._on_response(state, req, resp)
+                    # handler work runs on the bounded pool; awaiting
+                    # it keeps THIS connection's replies in request
+                    # order while every other connection's coroutine
+                    # stays runnable
+                    resp = await loop.run_in_executor(
+                        self._pool, self._dispatch, state, req)
                 faults.fire("wire.reply")
-                f.write(json.dumps(resp, separators=(",", ":"))
-                        .encode() + b"\n")
-                f.flush()
+                writer.write(json.dumps(resp, separators=(",", ":"))
+                             .encode() + b"\n")
+                await writer.drain()
         except (OSError, ValueError):
-            pass            # client went away mid-write
+            pass            # client went away mid-exchange
+        except asyncio.CancelledError:
+            pass            # stop(): unwind through the finally
         finally:
+            self._tasks.discard(task)
             try:
-                f.close()
-                conn.close()
+                writer.close()
             except OSError:
                 pass
             with self._lock:
@@ -268,50 +342,44 @@ class WireServer:
                     self._conns.remove(conn)
                 except ValueError:
                     pass    # stop() already swept it
-                me = threading.current_thread()
-                if me in self._threads:
-                    self._threads.remove(me)
+            # the reaping hook runs on the loop thread: it must stay
+            # cheap (the subclass contract) and calling it here — not
+            # on the pool — guarantees exactly-once even when stop()
+            # has already torn the pool down
             self._conn_closed(state)
 
     def stop(self) -> None:
         self._running = False
-        if self._listener is not None:
+        loop = self._loop
+        if loop is not None and not loop.is_closed() \
+                and self._stop_ev is not None:
+            try:
+                loop.call_soon_threadsafe(self._stop_ev.set)
+            except RuntimeError:
+                pass        # loop already closed under us
+        elif self._listener is not None:
+            # never started (or the loop died before serving): just
+            # release the port
             try:
                 self._listener.close()
             except OSError:
                 pass
-        # snapshot under _lock: handler threads may still be mutating
-        # the registry (an accept racing the _running flip) while
-        # shutdown walks it
-        with self._lock:
-            conns = list(self._conns)
-        for c in conns:
-            # shutdown BEFORE close: the reader thread's makefile
-            # object holds a reference, so close() alone only drops a
-            # refcount — the fd (and the connection's claim on the
-            # port) would survive until the blocked readline noticed,
-            # which on an idle connection is the idle timeout away.
-            # shutdown unblocks the read immediately, so a stopped
-            # server really releases its port (the restart-in-place
-            # path recovery depends on)
-            try:
-                c.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                c.close()
-            except OSError:
-                pass
-        # bounded join: handler threads unblock the moment their conn
-        # is shut down above, and the accept thread exits on the
-        # closed listener — joining makes stop() a real barrier, so no
-        # handler races interpreter teardown writing to closed sockets
+        # bounded join: the loop thread exits once _main's finally
+        # has closed the listener and every connection — joining makes
+        # stop() a real barrier, so no conn coroutine races
+        # interpreter teardown writing to closed sockets
         with self._lock:
             threads = list(self._threads)
         me = threading.current_thread()
         for t in threads:
             if t is not me:     # a handler op may itself call stop()
                 t.join(timeout=2.0)
+        if self._pool is not None:
+            # wait=False: a wedged handler gets the same 2s grace the
+            # threaded kernel gave, not a veto over shutdown (stop()
+            # may itself be running ON a pool thread — a handler op
+            # calling stop() must not join itself)
+            self._pool.shutdown(wait=False, cancel_futures=True)
 
     def serve_forever(self) -> None:
         """start() + block until KeyboardInterrupt (the CLI path)."""
