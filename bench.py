@@ -3085,6 +3085,484 @@ def serve_sharded_main() -> None:
         sys.exit(1)
 
 
+def store_child_main() -> None:
+    """`bench.py --store-child`: one cooperating tuning process of the
+    `--store-remote` bench — a journaled library Tuner over rosenbrock
+    whose serve loop mirrors the controller's store integration
+    (lookup-before-measure, record-after-measure, exchange + federate
+    on the refresh tick), pointed either at a shared `ut store` server
+    (--addr tcp://...) or at nothing (--addr off: the independent
+    matched-seed replica).  Prints ONE JSON line: evals-to-target,
+    best, store/guard accounting, and the online quality gauges the
+    parent holds to exact equality with an offline journal replay."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--store-child", action="store_true")
+    p.add_argument("--addr", required=True)
+    p.add_argument("--seed", type=int, required=True)
+    p.add_argument("--budget", type=int, default=120)
+    p.add_argument("--dims", type=int, default=2)
+    p.add_argument("--lo", type=float, default=-2.048)
+    p.add_argument("--hi", type=float, default=2.048)
+    p.add_argument("--as-int", action="store_true")
+    p.add_argument("--target", type=float, default=0.05)
+    p.add_argument("--journal", required=True)
+    p.add_argument("--tag", default="child")
+    p.add_argument("--exchange-interval", type=float, default=0.3)
+    args = p.parse_args()
+
+    from uptune_tpu.utils.platform_guard import force_cpu
+    force_cpu(1)
+    import jax  # noqa: F401  (backend must init after force_cpu)
+
+    import collections
+
+    import numpy as np
+
+    from uptune_tpu import obs
+    from uptune_tpu.analysis.lock_guard import lock_guard_from_env
+    from uptune_tpu.analysis.trace_guard import guard_from_env
+    from uptune_tpu.driver import Tuner
+    from uptune_tpu.workloads import rosenbrock_space
+
+    lockg = lock_guard_from_env(name=f"store-child-{args.tag}").install()
+    dims = args.dims
+    # the int grid is what makes cooperation structurally decisive:
+    # sibling configs collide, so the cross-tenant memo serves real
+    # hits and the fleet covers the lattice together
+    space = rosenbrock_space(dims, args.lo, args.hi, as_int=args.as_int)
+
+    def measure(cfg):
+        x = np.array([float(cfg[f"x{i}"]) for i in range(dims)])
+        return float(np.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                            + (1 - x[:-1]) ** 2))
+
+    store = None
+    if args.addr != "off":
+        from uptune_tpu.store.remote import RemoteStore
+        store = RemoteStore(args.addr, [repr(s) for s in space.specs],
+                            "bench-store-remote",
+                            refresh_interval=args.exchange_interval)
+    with guard_from_env() as guard:
+        obs.enable(capacity=1 << 18)
+        jmon = obs.start_journal(args.journal, meta={
+            "example": "bench.py --store-remote", "tag": args.tag,
+            "seed": args.seed, "addr": args.addr,
+            "workload": f"rosenbrock-{dims}d"
+                        + ("-int" if args.as_int else "")})
+        # sync refit: the run must be deterministic given its input
+        # stream so the coop-vs-independent comparison is seed-matched
+        tuner = Tuner(space, None, seed=args.seed, surrogate="gp",
+                      surrogate_opts=dict(min_points=12,
+                                          refit_interval=16,
+                                          max_points=192,
+                                          async_refit=False))
+        evals = 0
+        best = float("inf")
+        hit_at = None
+        exchange_injected = 0
+        federated = 0
+        queue: collections.deque = collections.deque()
+
+        def serve(tr):
+            """One trial through the controller's store discipline."""
+            nonlocal evals, best
+            row = store.lookup(tr.config) if store is not None else None
+            if row is not None:
+                q = float(row["qor"])
+                tuner.tell(tr, q, float(row.get("dur", 0.0)))
+                obs.journal.emit("store_hit", gid=tr.gid,
+                                 qor=round(q, 6))
+            else:
+                q = measure(tr.config)
+                evals += 1
+                tuner.tell(tr, q)
+                if store is not None:
+                    tk = tr.ticket
+                    store.record(tr.config, q,
+                                 u=tk.u_np[tr.slot],
+                                 perms=[pp[tr.slot]
+                                        for pp in tk.perms_np])
+            best = min(best, q)
+
+        while evals < args.budget and \
+                not (hit_at is not None and not queue):
+            if not queue:
+                queue.extend(tuner.ask(min_trials=1))
+            serve(queue.popleft())
+            if hit_at is None and best <= args.target:
+                hit_at = evals
+            if store is not None and store.maybe_refresh():
+                rows = store.pop_fresh_rows()
+                if rows:
+                    # elite migration + federated surrogate rows: the
+                    # controller's _maybe_exchange_best split exactly
+                    top = min(rows, key=lambda r: float(r["qor"]))
+                    injected = []
+                    if tuner.sign * float(top["qor"]) \
+                            < float(tuner.best.qor):
+                        injected = tuner.inject([top["cfg"]],
+                                                source="exchange")
+                    if injected:
+                        exchange_injected += len(injected)
+                        obs.journal.emit(
+                            "exchange", qor=round(float(top["qor"]), 6))
+                        queue.extendleft(reversed(injected))
+                    rest = [r for r in rows
+                            if not (injected and r is top)]
+                    n = tuner.preload_rows(rest, refit=False)
+                    if n:
+                        federated += n
+                        if tuner.surrogate is not None:
+                            tuner.surrogate.maybe_refit()
+                        obs.journal.emit("federate", rows=n)
+        res = tuner.result()
+        tuner.close()
+        obs.journal.flush()
+        obs.stop_journal(jmon)      # finalizes the monitor's tail
+        gauges = dict(jmon.gauges)
+    sstats = store.stats() if store is not None else None
+    if store is not None:
+        store.flush_wait(10.0)
+        store.close()
+    lockg.uninstall()
+    out = {"tag": args.tag, "seed": args.seed, "evals": evals,
+           "hit_at": hit_at, "best": round(best, 6),
+           "tuner_best": round(res.best_qor, 6),
+           "exchange_injected": exchange_injected,
+           "federated": federated, "store": sstats, "gauges": gauges}
+    if guard.enabled:
+        out["retraces"] = guard.report()
+    if lockg.enabled:
+        out["lock_sanitizer"] = lockg.report()
+        lockg.check()
+    print(json.dumps(out), flush=True)
+
+
+def store_remote_main() -> None:
+    """`bench.py --store-remote`: the cooperative search fabric bench
+    (ISSUE 18, docs/STORE.md "Remote store").
+
+    Phase 1 — cooperation quality: one `ut store` server subprocess;
+    K=3 journaled tuning child processes join it over real localhost
+    TCP (elite migration + federated surrogate rows) vs 3 independent
+    matched-seed replicas at the same budget.  Gated (full runs): the
+    cooperating fleet reaches the target QoR in fewer evaluations
+    than the best independent replica.  Every child's online quality
+    gauges must equal an offline `obs.quality.replay` of the journal
+    it wrote (the PR 12 bit-exact claim), and the winning coop
+    journal must render through the `ut report` pipeline.
+
+    Phase 2 — the kill: a fresh store server armed with a
+    deterministic `rstore.append=crash@N` fault (obs/faults.py) dies
+    mid-append — os._exit inside the durable-append window, the
+    SIGKILL stand-in — under live RemoteStore writers.  Asserted:
+    rc 137; every row the server ACKED before the crash is served by
+    a restarted server on the same directory (zero acked-row loss,
+    pure log replay — the ack-after-durable contract); clients
+    degrade to fast local-only records while the server is down and
+    the surviving client reconnects and drains its write-behind
+    backlog transparently.
+
+    The whole bench runs under the strict lock sanitizer (forced on
+    in --quick: the tier-1 smoke), and children inherit
+    UT_TRACE_GUARD=strict.  Writes BENCH_STORE_REMOTE.json
+    (.quick.json for --quick)."""
+    quick = "--quick" in sys.argv
+    if quick:
+        # satellite: the tier-1 smoke always runs the store-server
+        # fabric under the strict lock sanitizer, parent AND children
+        os.environ.setdefault("UT_LOCK_GUARD", "strict")
+        os.environ.setdefault("UT_TRACE_GUARD", "strict")
+
+    import shutil
+    import socket as _socket
+    import subprocess
+    import tempfile
+    import threading
+
+    from uptune_tpu import obs
+    from uptune_tpu.analysis.lock_guard import lock_guard_from_env
+    from uptune_tpu.store.remote import RemoteStore
+
+    lockg = lock_guard_from_env(name="store-remote-bench").install()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="ut_store_remote_bench_")
+    result: dict = {"metric": "store_remote", "quick": quick,
+                    "nproc": os.cpu_count()}
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def wait_ready(port, child, what, deadline_s=120):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            try:
+                probe = _socket.create_connection(("127.0.0.1", port),
+                                                  timeout=2)
+                probe.close()
+                return
+            except OSError:
+                if child.poll() is not None:
+                    raise RuntimeError(
+                        f"{what} died before ready: "
+                        + child.communicate()[0][-2000:])
+                time.sleep(0.1)
+        raise RuntimeError(f"{what} never came up")
+
+    def req(port, payload):
+        """One raw wire request to a store-server subprocess."""
+        with _socket.create_connection(("127.0.0.1", port),
+                                       timeout=10) as s:
+            f = s.makefile("rwb")
+            f.write(json.dumps(payload).encode() + b"\n")
+            f.flush()
+            resp = json.loads(f.readline())
+        assert resp.get("ok"), resp
+        return resp
+
+    def start_server(port, root, env=None):
+        child = subprocess.Popen(
+            [sys.executable, "-m", "uptune_tpu.cli", "store",
+             "--port", str(port), "--dir", root],
+            cwd=workdir, env=env or dict(os.environ, PYTHONPATH=repo),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        wait_ready(port, child, "ut store")
+        return child
+
+    # ---- phase 1: K=3 cooperating vs 3 independent replicas ----------
+    # rosenbrock on an INTEGER lattice (13^dims configs): sibling
+    # proposals collide, so the shared store serves real memo hits and
+    # elite migration pulls every replica into the winning basin —
+    # cooperation beats independent-replica luck on evals-to-target
+    k = 3
+    dims = 3 if quick else 4
+    budget = 150 if quick else 300
+    target = 3.0
+    lo, hi = -6, 6
+    seeds = [9100 + i for i in range(k)]
+    port = free_port()
+    server = start_server(port, os.path.join(workdir, "store"))
+
+    def run_fleet(label, addr):
+        children, outs = [], []
+        for i, seed in enumerate(seeds):
+            jpath = os.path.join(workdir, f"journal_{label}_{i}.jsonl")
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--store-child", "--addr", addr,
+                   "--seed", str(seed), "--budget", str(budget),
+                   "--dims", str(dims), "--lo", str(lo),
+                   "--hi", str(hi), "--as-int",
+                   "--target", str(target),
+                   "--journal", jpath, "--tag", f"{label}-{i}",
+                   "--exchange-interval", "0.02"]
+            children.append((jpath, subprocess.Popen(
+                cmd, cwd=workdir,
+                env=dict(os.environ, JAX_PLATFORMS="cpu",
+                         PYTHONPATH=repo),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)))
+        for jpath, ch in children:
+            txt = ch.communicate(timeout=900)[0]
+            if ch.returncode != 0:
+                raise RuntimeError(f"{label} child failed "
+                                   f"(rc={ch.returncode}): {txt[-3000:]}")
+            line = [ln for ln in txt.strip().splitlines()
+                    if ln.startswith("{")][-1]
+            outs.append((jpath, json.loads(line)))
+        return outs
+
+    try:
+        coop = run_fleet("coop", f"tcp://127.0.0.1:{port}")
+    finally:
+        server.terminate()
+        server.wait()
+    indep = run_fleet("indep", "off")
+
+    # the PR 12 bit-exact claim: every child's ONLINE gauges equal an
+    # offline replay of the journal it wrote
+    from uptune_tpu.obs import report as obs_report
+    replay_exact = True
+    for jpath, out in coop + indep:
+        _, rows = obs.journal.read(jpath, strict=True)
+        replayed = obs.quality.replay(rows)
+        if out["gauges"] != replayed.gauges:
+            replay_exact = False
+            result.setdefault("replay_diffs", []).append({
+                "tag": out["tag"],
+                "diff": {kk: (out["gauges"].get(kk),
+                              replayed.gauges.get(kk))
+                         for kk in set(out["gauges"])
+                         | set(replayed.gauges)
+                         if out["gauges"].get(kk)
+                         != replayed.gauges.get(kk)}})
+
+    def hit(o):
+        # a replica that never reached the target counts as budget+1
+        return o["hit_at"] if o["hit_at"] is not None else budget + 1
+
+    coop_hits = [hit(o) for _, o in coop]
+    indep_hits = [hit(o) for _, o in indep]
+    coop_min, indep_min = min(coop_hits), min(indep_hits)
+    migrated = sum(o["exchange_injected"] for _, o in coop)
+    federated = sum(o["federated"] for _, o in coop)
+    # the winning coop journal renders through `ut report`
+    win_jpath = min(coop, key=lambda c: hit(c[1]))[0]
+    report_md = obs_report.render(win_jpath, fmt="md")
+    def guard_clean(o):
+        # strict children already die on violation; belt-and-braces
+        tr = o.get("retraces") or {}
+        limit = tr.get("limit", 1)
+        return all(v <= limit for v in (tr.get("traces") or {}).values())
+
+    children_guard_ok = all(guard_clean(o) for _, o in coop + indep)
+    result["phase1"] = {
+        "k": k, "dims": dims, "lo": lo, "hi": hi, "as_int": True,
+        "budget": budget, "target": target,
+        "seeds": seeds, "exchange_interval_s": 0.02,
+        "coop_evals_to_target": coop_hits,
+        "indep_evals_to_target": indep_hits,
+        "coop_min": coop_min, "indep_min": indep_min,
+        "coop_beats_indep": coop_min < indep_min,
+        "exchange_injected": migrated, "federated_rows": federated,
+        "coop": [o for _, o in coop], "indep": [o for _, o in indep],
+        "journal_replay_exact": replay_exact,
+        "report_md_lines": report_md.count("\n"),
+        "children_trace_guard_clean": children_guard_ok,
+    }
+    print(f"bench --store-remote: coop evals-to-target {coop_hits} "
+          f"vs independent {indep_hits} (min {coop_min} vs "
+          f"{indep_min}, {migrated} migrations, {federated} federated "
+          f"rows)", file=sys.stderr)
+
+    # ---- phase 2: the deterministic mid-append kill ------------------
+    crash_at = 25
+    port2 = free_port()
+    root2 = os.path.join(workdir, "store_crash")
+    env2 = dict(os.environ, PYTHONPATH=repo,
+                UT_FAULTS=f"rstore.append=crash@{crash_at}")
+    server2 = start_server(port2, root2, env=env2)
+    sig = ["bench-crash-spec"]
+    clients = [RemoteStore(f"tcp://127.0.0.1:{port2}", sig,
+                           "bench-crash", refresh_interval=3600.0,
+                           backoff_base=0.05, backoff_max=0.5)
+               for _ in range(k)]
+    rec_keys: list = [[] for _ in range(k)]   # per client, record order
+    stop_rec = threading.Event()
+
+    def writer(ci):
+        n = 0
+        while not stop_rec.is_set() and n < 200:
+            row = clients[ci].record({"c": ci, "i": n}, float(n + 1),
+                                     source=f"w{ci}")
+            if row is not None:
+                rec_keys[ci].append(row["k"])
+            n += 1
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(k)]
+    for t in threads:
+        t.start()
+    server2.wait()          # dies at its crash_at-th durable append
+    crash_rc = server2.returncode
+    t_crash = time.perf_counter()
+    # degradation: with the server dead, a record() is a local insert
+    # + bounded enqueue — never a dial, never a stall
+    t0 = time.perf_counter()
+    clients[0].record({"deg": "probe"}, 999.0)
+    degrade_ms = (time.perf_counter() - t0) * 1e3
+    stop_rec.set()
+    for t in threads:
+        t.join()
+    # snapshot the acked frontier: the flusher ships FIFO, so each
+    # client's acked count prefixes its record order exactly
+    acked_at_crash = [c.stats()["remote"]["acked"] for c in clients]
+    acked_keys = [ks[:a] for ks, a in zip(rec_keys, acked_at_crash)]
+    # two clients stop here, with the server DOWN: whatever the log
+    # holds for them is all a restarted server can know — the pure
+    # replay side of the zero-acked-loss check
+    closed_unshipped = 0
+    for c in clients[1:]:
+        s = c.stats()["remote"]
+        closed_unshipped += s["queued"]
+        c.close()
+    # restart on the SAME directory (no fault armed this time)
+    server3 = start_server(port2, root2)
+    try:
+        lost = []
+        for ks in acked_keys:
+            for key in ks:
+                r = req(port2, {"op": "lookup", "k": key})
+                if r.get("row") is None:
+                    lost.append(key)
+        st = req(port2, {"op": "stats"})
+        # the surviving client reconnects and drains its backlog
+        drained = clients[0].flush_wait(30.0)
+        resumed = clients[0].connected
+        survivor_ok = True
+        for key in rec_keys[0]:
+            if req(port2, {"op": "lookup", "k": key}).get("row") is None:
+                survivor_ok = False
+        dropped = sum(c.stats()["remote"]["dropped"]
+                      for c in (clients[0],))
+    finally:
+        clients[0].close()
+        server3.terminate()
+        server3.wait()
+    result["phase2"] = {
+        "crash_at_append": crash_at, "crash_rc": crash_rc,
+        "acked_at_crash": acked_at_crash,
+        "acked_rows_lost": len(lost),
+        "degraded_record_ms": round(degrade_ms, 3),
+        "closed_with_unshipped": closed_unshipped,
+        "survivor_drained": drained, "survivor_resumed": resumed,
+        "survivor_all_rows_on_server": survivor_ok,
+        "survivor_dropped": dropped,
+        "server_after_restart": {"rows": st["rows"],
+                                 "replayed": st["replayed"],
+                                 "torn_tail": st["torn_tail"]},
+    }
+    print(f"bench --store-remote: crash rc {crash_rc} at append "
+          f"{crash_at}; {sum(acked_at_crash)} acked rows, "
+          f"{len(lost)} lost; survivor drained={drained} "
+          f"(degraded record {degrade_ms:.1f} ms)", file=sys.stderr)
+
+    shutil.rmtree(workdir, ignore_errors=True)
+    lockg.uninstall()
+    if lockg.enabled:
+        result["lock_sanitizer"] = lockg.report()
+        lockg.check()   # strict: raise on any lock-order cycle
+    # quality gates only the FULL run (the quick smoke runs 6 jax
+    # children on a 1-core CI box — it gates the correctness
+    # contracts and records the comparison honestly)
+    ok = ((coop_min < indep_min or quick) and replay_exact
+          and children_guard_ok
+          and crash_rc == 137 and not lost
+          and sum(acked_at_crash) > 0
+          and drained and resumed and survivor_ok and dropped == 0
+          and degrade_ms < 100.0)
+    result["ok"] = ok
+    name = ("BENCH_STORE_REMOTE.quick.json" if quick
+            else "BENCH_STORE_REMOTE.json")
+    path = os.path.join(repo, name)
+    with open(path, "w") as f:
+        json.dump({**result, "captured_unix": time.time()}, f, indent=1)
+    print(f"bench: cooperative-store evidence written to {path}",
+          file=sys.stderr)
+    print(json.dumps({"metric": "store_remote_ok", "value": ok,
+                      "coop_min": coop_min, "indep_min": indep_min,
+                      "acked_lost": len(lost), "quick": quick}))
+    if not ok:
+        sys.exit(1)
+
+
 def main() -> None:
     if "--obs" in sys.argv:
         obs_main()
@@ -3115,6 +3593,12 @@ def main() -> None:
         return
     if "--serve-sharded" in sys.argv:
         serve_sharded_main()
+        return
+    if "--store-child" in sys.argv:
+        store_child_main()
+        return
+    if "--store-remote" in sys.argv:
+        store_remote_main()
         return
     if "--serve" in sys.argv:
         serve_main()

@@ -34,7 +34,11 @@ fi
 # uptune_tpu/store/, uptune_tpu/surrogate/, uptune_tpu/engine/,
 # uptune_tpu/ops/, uptune_tpu/obs/ and uptune_tpu/serve/ must stay
 # SUPPRESSION-FREE on top of clean: cache-correctness code (what
-# decides whether a build is skipped, ISSUE 4), the concurrent
+# decides whether a build is skipped, ISSUE 4; since ISSUE 18 the
+# package also carries the cooperative search fabric — store/server.py
+# whose ack-after-durable append IS the zero-acked-loss contract, and
+# store/remote.py whose write-behind flusher sits on the tell path of
+# every cooperating tuner), the concurrent
 # background-refit plane (ISSUE 5), the fused/batched engine + Pallas
 # kernels every perf headline rests on (ISSUE 6), the observability
 # plane whose instrumentation lives INSIDE every hot path (ISSUE 7 —

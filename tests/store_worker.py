@@ -1,0 +1,81 @@
+"""Jax-free cooperative-store worker process for the two-process
+loopback tests (tests/test_multihost.py TestTwoProcessCooperativeStore,
+ISSUE 18).
+
+Holds one RemoteStore client (store/remote.py) pointed at the shared
+`ut store` server whose address arrives in argv, and exposes a tiny
+wire surface of its own so the parent test can command records and
+observe what the client sees — all over real localhost TCP, zero jax.
+Prints ``PORT <n>`` once listening; exits when its stdin closes (the
+parent's teardown signal — no signal races, no orphan on parent
+death)."""
+import sys
+
+
+def main() -> int:
+    addr, tag = sys.argv[1], sys.argv[2]
+
+    from uptune_tpu.serve.wire import WireServer
+    from uptune_tpu.store.remote import RemoteStore
+
+    store = RemoteStore(addr, ["coop-loopback-spec"], "coop-loopback",
+                        refresh_interval=0.0)
+
+    class Worker(WireServer):
+        WIRE_NAME = "ut-store-worker"
+
+        def __init__(self) -> None:
+            super().__init__("127.0.0.1", 0)
+            self.foreign = 0
+
+        def _op_ping(self, req: dict) -> dict:
+            return {"role": "store-worker", "tag": tag}
+
+        def _op_record(self, req: dict) -> dict:
+            """Record n rows under this worker's tag and wait until
+            every one of them is ACKED by the store server."""
+            n = int(req.get("n", 1))
+            keys = []
+            for i in range(n):
+                row = store.record({"w": tag, "i": i},
+                                   float(req.get("base", 0.0)) + i)
+                if row is not None:
+                    keys.append(row["k"])
+            shipped = store.flush_wait(20.0)
+            return {"keys": keys, "shipped": shipped}
+
+        def _op_sync(self, req: dict) -> dict:
+            """Pull the server's delta feed and report what this
+            client now knows — fresh rows are the sibling's."""
+            merged = store.refresh()
+            fresh = store.pop_fresh_rows()
+            with self._lock:
+                self.foreign += len(fresh)
+            best = store.best_row()
+            return {"merged": merged,
+                    "fresh": [r["cfg"] for r in fresh],
+                    "foreign_total": self.foreign,
+                    "rows": len(store),
+                    "best_qor": None if best is None else best["qor"]}
+
+        def _op_lookup(self, req: dict) -> dict:
+            row = store.lookup(dict(req["cfg"]))
+            return {"row": row}
+
+        def _op_stats(self, req: dict) -> dict:
+            return {"stats": store.stats()}
+
+        _OPS = {"ping": _op_ping, "record": _op_record,
+                "sync": _op_sync, "lookup": _op_lookup,
+                "stats": _op_stats}
+
+    w = Worker().start()
+    print(f"PORT {w.port}", flush=True)
+    sys.stdin.read()            # parent closes stdin to stop us
+    w.stop()
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

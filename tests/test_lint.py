@@ -625,7 +625,11 @@ def test_package_suppression_free(package):
     """Packages on the correctness-critical fast path must be finding-
     AND suppression-free: no '# ut-lint: disable' escape hatch, no
     baseline.  store/ decides whether a build is SKIPPED (cache
-    correctness, ISSUE 4); surrogate/ now runs a concurrent background
+    correctness, ISSUE 4) and since ISSUE 18 carries the cooperative
+    search fabric — server.py, whose ack-after-durable append IS the
+    zero-acked-loss contract, and remote.py, whose write-behind
+    flusher sits on every cooperating tuner's tell path; surrogate/
+    now runs a concurrent background
     refit thread (ISSUE 5) — a silenced host-sync or retrace hazard
     there would hide a stall on the very path this PR moved off the
     driver; engine/ and ops/ carry the fused/batched acquisition loop

@@ -364,6 +364,88 @@ class TestTwoProcessLoopback:
                     p.kill()
 
 
+class TestTwoProcessCooperativeStore:
+    """The cooperative-store loopback sibling of TestTwoProcessLoopback
+    (ISSUE 18): two jax-free worker processes, each holding a
+    RemoteStore client, cooperate through one StoreServer over real
+    localhost TCP — record/ack, cross-worker delta feeds, and the
+    shared-memo lookup that IS the fabric's reason to exist.  Runs in
+    tier-1 unconditionally."""
+
+    @staticmethod
+    def _req(port, payload):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=10) as s:
+            f = s.makefile("rwb")
+            f.write((json.dumps(payload) + "\n").encode())
+            f.flush()
+            resp = json.loads(f.readline())
+        assert resp.get("ok"), resp
+        return resp
+
+    def test_two_workers_share_one_store(self, tmp_path):
+        from uptune_tpu.store.server import StoreServer
+        from uptune_tpu.utils.pypath import child_pythonpath
+        srv = StoreServer("127.0.0.1", 0,
+                          str(tmp_path / "store")).start()
+        worker = os.path.join(os.path.dirname(__file__),
+                              "store_worker.py")
+        env = dict(os.environ, PYTHONPATH=child_pythonpath())
+        addr = f"tcp://127.0.0.1:{srv.port}"
+        procs = [subprocess.Popen(
+            [sys.executable, worker, addr, tag],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=env)
+            for tag in ("a", "b")]
+        try:
+            ports = []
+            for p in procs:
+                line = p.stdout.readline().strip()
+                assert line.startswith("PORT "), line
+                ports.append(int(line.split()[1]))
+            pa, pb = ports
+
+            # A records 10 acked rows; B's delta pull sees exactly
+            # those 10 as FOREIGN fresh rows (elite-migration feed)
+            ra = self._req(pa, {"op": "record", "n": 10, "base": 5.0})
+            assert len(ra["keys"]) == 10 and ra["shipped"]
+            sb = self._req(pb, {"op": "sync"})
+            assert sb["merged"] == 10 and len(sb["fresh"]) == 10
+            assert all(c["w"] == "a" for c in sb["fresh"])
+            assert sb["best_qor"] == 5.0
+
+            # B records 4; A sees only B's 4 (its own never echo back)
+            rb = self._req(pb, {"op": "record", "n": 4, "base": 1.0})
+            assert len(rb["keys"]) == 4 and rb["shipped"]
+            sa = self._req(pa, {"op": "sync"})
+            assert len(sa["fresh"]) == 4
+            assert all(c["w"] == "b" for c in sa["fresh"])
+            assert sa["rows"] == 14 and sa["best_qor"] == 1.0
+
+            # the cross-tenant memo: A serves B's measurement by key
+            la = self._req(pa, {"op": "lookup",
+                                "cfg": {"w": "b", "i": 2}})
+            assert la["row"] is not None and la["row"]["qor"] == 3.0
+
+            # a second sync is quiet — the delta cursor advanced
+            assert self._req(pb, {"op": "sync"})["merged"] == 0
+            st = self._req(pa, {"op": "stats"})["stats"]["remote"]
+            assert st["connected"] and st["acked"] == 10
+            assert st["dropped"] == 0
+            with srv._lock:
+                assert srv.recorded == 14 and srv.dups == 0
+        finally:
+            srv.stop()
+            for p in procs:
+                if p.stdin:
+                    p.stdin.close()     # the worker's exit signal
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
 @pytest.mark.slow
 class TestLauncherTune:
     def test_two_replica_program_tune(self, tmp_path):

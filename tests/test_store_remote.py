@@ -1,0 +1,322 @@
+"""Cooperative search fabric tests (ISSUE 18): the networked
+ResultStore server (store/server.py) and its RemoteStore client
+(store/remote.py).
+
+TestParseAddr/TestOpenStoreFactory cover the address grammar and the
+``--store tcp://`` routing seam.  TestStoreServerOps drives the server
+transport-free through WireServer.handle(): content-key idempotency,
+the per-requester delta cursor (scope/src/incarnation semantics), and
+torn-tail log replay.  TestRemoteStoreFailureModes uses real localhost
+TCP for the degradation contract: dead-server-at-open loud fallback,
+bounded write-behind under a mid-run disconnect, and idempotent
+re-delivery after reconnect.  TestStoreRemoteBenchSmoke runs the
+`bench.py --store-remote --quick` fabric bench end-to-end (tier-1, the
+ISSUE 18 smoke).  No jax anywhere on the client/server path."""
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from uptune_tpu.store import is_remote_addr, open_store  # noqa: E402
+from uptune_tpu.store.remote import RemoteStore, parse_addr  # noqa: E402
+from uptune_tpu.store.server import StoreServer  # noqa: E402
+from uptune_tpu.store.store import ResultStore  # noqa: E402
+
+SIG = ["spec-a", "spec-b"]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------------
+class TestParseAddr:
+    def test_grammar(self):
+        assert parse_addr("tcp://10.1.2.3:8791") == ("10.1.2.3", 8791)
+        assert parse_addr("tcp://localhost:80") == ("localhost", 80)
+        assert parse_addr("127.0.0.1:9") == ("127.0.0.1", 9)
+
+    @pytest.mark.parametrize("bad", [
+        "tcp://", "tcp://host", "host", "tcp://host:nan",
+        "tcp://host:0", "tcp://:123", "http://h:1"])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_addr(bad)
+
+
+class TestOpenStoreFactory:
+    def test_routes_by_prefix(self, tmp_path):
+        assert is_remote_addr("tcp://h:1") and not is_remote_addr(
+            str(tmp_path))
+        st = open_store(str(tmp_path / "s"), SIG, ["cmd"])
+        try:
+            assert isinstance(st, ResultStore)
+        finally:
+            st.close()
+        port = _free_port()
+        srv = StoreServer("127.0.0.1", port,
+                          str(tmp_path / "srv")).start()
+        try:
+            rt = open_store(f"tcp://127.0.0.1:{port}", SIG, ["cmd"])
+            try:
+                assert isinstance(rt, RemoteStore) and rt.connected
+            finally:
+                rt.close()
+        finally:
+            srv.stop()
+
+    def test_empty_store_is_truthy(self, tmp_path):
+        # ``if store:`` call sites must not silently disable an
+        # open-but-empty store (it defines __len__)
+        st = open_store(str(tmp_path / "s"), SIG, ["cmd"])
+        try:
+            assert len(st) == 0 and bool(st)
+        finally:
+            st.close()
+
+
+# ---------------------------------------------------------------------
+class TestStoreServerOps:
+    """Transport-free op semantics through WireServer.handle()."""
+
+    def _row(self, i=0, qor=1.0, scope="sc", src="w0"):
+        return {"k": f"k{i}", "scope": scope, "cfg": {"i": i},
+                "qor": qor, "src": src}
+
+    def test_record_is_content_key_idempotent(self, tmp_path):
+        srv = StoreServer("127.0.0.1", 0, str(tmp_path))
+        r1 = srv.handle({"op": "record", "row": self._row()})
+        assert r1["ok"] and r1["acked"] and not r1["dup"]
+        # the replayed duplicate (ack lost, client re-sent): ACKED
+        # again but NOT re-appended — restart-safe dedup
+        r2 = srv.handle({"op": "record", "row": self._row()})
+        assert r2["ok"] and r2["acked"] and r2["dup"]
+        assert srv.appends == 1 and srv.dups == 1
+        srv.stop()
+
+    def test_failure_rows_recorded_but_never_served(self, tmp_path):
+        srv = StoreServer("127.0.0.1", 0, str(tmp_path))
+        srv.handle({"op": "record", "row": self._row(qor=None)})
+        miss = srv.handle({"op": "lookup", "k": "k0"})
+        assert miss["ok"] and miss["row"] is None
+        d = srv.handle({"op": "delta", "scope": "sc", "cursor": 0,
+                        "src": "other"})
+        assert d["rows"] == []          # delta feeds finite rows only
+        # a later finite result for the same key upgrades the row
+        srv.handle({"op": "record", "row": self._row(qor=7.5)})
+        hit = srv.handle({"op": "lookup", "k": "k0"})
+        assert hit["row"]["qor"] == 7.5
+        srv.stop()
+
+    def test_delta_cursor_scope_src_semantics(self, tmp_path):
+        srv = StoreServer("127.0.0.1", 0, str(tmp_path))
+        for i in range(3):
+            srv.handle({"op": "record", "row": self._row(i, 1.0 + i,
+                                                         src="wa")})
+        srv.handle({"op": "record",
+                    "row": self._row(9, 0.5, scope="other",
+                                     src="wb")})
+        # src filter: wa never gets its own rows back
+        d = srv.handle({"op": "delta", "scope": "sc", "cursor": 0,
+                        "incarn": srv.incarn, "src": "wa"})
+        assert d["ok"] and d["rows"] == []
+        # a sibling sees exactly the in-scope rows, cursor advances
+        d = srv.handle({"op": "delta", "scope": "sc", "cursor": 0,
+                        "incarn": srv.incarn, "src": "wb"})
+        assert [r["k"] for r in d["rows"]] == ["k0", "k1", "k2"]
+        assert d["cursor"] == 4 and not d["more"]
+        d2 = srv.handle({"op": "delta", "scope": "sc",
+                         "cursor": d["cursor"],
+                         "incarn": srv.incarn, "src": "wb"})
+        assert d2["rows"] == []
+        # a stale incarnation (client survived a server restart):
+        # the cursor is meaningless — the feed restarts from 0
+        d3 = srv.handle({"op": "delta", "scope": "sc", "cursor": 99,
+                         "incarn": "someone-else", "src": "wb"})
+        assert len(d3["rows"]) == 3 and d3["incarn"] == srv.incarn
+        srv.stop()
+
+    def test_torn_tail_replay(self, tmp_path):
+        srv = StoreServer("127.0.0.1", 0, str(tmp_path))
+        srv.handle({"op": "record", "row": self._row(0, 1.0)})
+        srv.handle({"op": "record", "row": self._row(1, 2.0)})
+        srv.stop()
+        with open(srv.log_path, "ab") as f:
+            f.write(b'{"k": "torn", "scope": "sc", "cfg"')   # no \n
+        srv2 = StoreServer("127.0.0.1", 0, str(tmp_path))
+        assert srv2.replayed == 2 and srv2.torn_tail
+        assert srv2.handle({"op": "lookup", "k": "k1"})["row"][
+            "qor"] == 2.0
+        # the server stays writable past a torn tail
+        r = srv2.handle({"op": "record", "row": self._row(2, 3.0)})
+        assert r["acked"] and not r["dup"]
+        srv2.stop()
+
+    def test_health_and_metrics_shapes(self, tmp_path):
+        srv = StoreServer("127.0.0.1", 0, str(tmp_path))
+        h = srv.handle({"op": "health"})
+        assert h["role"] == "ut-store" and h["status"] == "cold"
+        assert h["by_status"] == {"cold": 1}
+        srv.handle({"op": "record", "row": self._row()})
+        assert srv.handle({"op": "health"})["status"] == "ok"
+        m = srv.handle({"op": "metrics"})
+        assert "metrics" in m and "uptime_s" in m
+        t = srv.handle({"op": "metrics", "format": "prometheus"})
+        assert "metrics_text" in t
+        srv.stop()
+
+
+# ---------------------------------------------------------------------
+class TestRemoteStoreFailureModes:
+    """The degradation contract over real localhost TCP."""
+
+    def test_dead_server_at_open_degrades_loudly(self, caplog):
+        port = _free_port()     # nobody listening
+        with caplog.at_level(logging.WARNING, logger="uptune_tpu"):
+            st = RemoteStore(f"tcp://127.0.0.1:{port}", SIG, "cmd",
+                             backoff_base=0.01, backoff_max=0.05)
+        try:
+            assert any("unreachable at open" in r.message
+                       for r in caplog.records)
+            assert not st.connected
+            # local-only service continues: record + lookup work
+            row = st.record({"p": 1}, 4.0)
+            assert row is not None
+            assert st.lookup({"p": 1})["qor"] == 4.0
+            assert st.stats()["remote"]["queued"] >= 1
+        finally:
+            st.close()
+
+    def test_mid_run_disconnect_bounds_write_behind(self, tmp_path):
+        port = _free_port()
+        srv = StoreServer("127.0.0.1", port, str(tmp_path)).start()
+        st = RemoteStore(f"tcp://127.0.0.1:{port}", SIG, "cmd",
+                         queue_max=8, batch_max=4, backoff_base=0.01,
+                         backoff_max=0.05)
+        try:
+            assert st.record({"i": -1}, 1.0) is not None
+            assert st.flush_wait(10.0)
+            srv.stop()          # mid-run death
+            for i in range(50):
+                assert st.record({"i": i}, float(i)) is not None
+            s = st.stats()["remote"]
+            # bounded write-behind: the queue sheds oldest (plus at
+            # most one in-flight ack-gated batch), and counts it
+            assert s["queued"] <= 8 + 4
+            assert s["dropped"] >= 50 - (8 + 4)
+            assert len(st) == 51        # local table keeps everything
+            # refresh on a dead wire is a cheap no-op, never a dial
+            assert st.refresh() == 0
+        finally:
+            st.close()
+
+    def test_reconnect_and_idempotent_redelivery(self, tmp_path):
+        port = _free_port()
+        root = str(tmp_path / "store")
+        srv = StoreServer("127.0.0.1", port, root).start()
+        st = RemoteStore(f"tcp://127.0.0.1:{port}", SIG, "cmd",
+                         backoff_base=0.01, backoff_max=0.05)
+        try:
+            st.record({"i": 0}, 1.0)
+            assert st.flush_wait(10.0)
+            srv.stop()
+            st.record({"i": 1}, 2.0)    # queues while down
+            # the same server identity comes back on the same log
+            srv2 = StoreServer("127.0.0.1", port, root).start()
+            try:
+                assert srv2.replayed == 1
+                assert st.flush_wait(10.0)      # flusher re-dialed
+                assert st.connected
+                with srv2._lock:
+                    assert len(srv2._rows) == 2
+                # duplicate delivery (ack lost → client re-sends) is
+                # absorbed by the content key, not re-appended
+                k = st.lookup({"i": 0})["k"]
+                before = srv2.appends
+                r = srv2.handle({"op": "record",
+                                 "row": {"k": k, "scope": st.scope,
+                                         "cfg": {"i": 0}, "qor": 1.0,
+                                         "src": "replayer"}})
+                assert r["acked"] and r["dup"]
+                assert srv2.appends == before
+            finally:
+                srv2.stop()
+        finally:
+            st.close()
+
+    def test_exchange_survives_server_restart(self, tmp_path):
+        """The delta cursor resets across a server incarnation change
+        and the feed replays from 0 without duplicating rows the
+        client already holds."""
+        port = _free_port()
+        root = str(tmp_path / "store")
+        srv = StoreServer("127.0.0.1", port, root).start()
+        a = RemoteStore(f"tcp://127.0.0.1:{port}", SIG, "cmd",
+                        backoff_base=0.01, backoff_max=0.05)
+        b = RemoteStore(f"tcp://127.0.0.1:{port}", SIG, "cmd",
+                        backoff_base=0.01, backoff_max=0.05)
+        try:
+            a.record({"i": 0}, 1.0)
+            assert a.flush_wait(10.0)
+            assert b.refresh() == 1
+            assert len(b.pop_fresh_rows()) == 1
+            srv.stop()
+            srv2 = StoreServer("127.0.0.1", port, root).start()
+            try:
+                # reconnect b (the flusher dials on queued work; a
+                # bare refresh must also survive the new incarnation)
+                b.record({"j": 9}, 9.0)
+                assert b.flush_wait(10.0)
+                # replayed rows re-arrive under the new incarnation
+                # but merge as already-known: nothing fresh to pop
+                b.refresh()
+                assert b.pop_fresh_rows() == []
+                assert len(b) == 2
+            finally:
+                srv2.stop()
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------
+class TestStoreRemoteBenchSmoke:
+    def test_store_remote_bench_quick_smoke(self, tmp_path):
+        """`bench.py --store-remote --quick` (the ISSUE 18 tier-1
+        smoke): a real `ut store` server under K=3 cooperating jax
+        children over localhost TCP, bit-exact journal replay, then
+        the deterministic mid-append SIGKILL with zero acked-row loss
+        — all under the strict lock sanitizer and trace guard."""
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--store-remote", "--quick", "--cpu"],
+            capture_output=True, text=True, env=env,
+            cwd=str(tmp_path), timeout=840)
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["metric"] == "store_remote_ok"
+        assert out["value"] is True
+        art = json.load(open(os.path.join(
+            REPO, "BENCH_STORE_REMOTE.quick.json")))
+        assert art["phase1"]["journal_replay_exact"]
+        assert art["phase1"]["children_trace_guard_clean"]
+        assert art["phase1"]["exchange_injected"] > 0
+        assert art["phase1"]["federated_rows"] > 0
+        assert art["phase2"]["crash_rc"] == 137
+        assert art["phase2"]["acked_rows_lost"] == 0
+        assert sum(art["phase2"]["acked_at_crash"]) > 0
+        assert art["phase2"]["survivor_drained"]
+        assert art["phase2"]["survivor_resumed"]
+        assert art["phase2"]["survivor_dropped"] == 0

@@ -61,6 +61,17 @@ DEFAULTS: Dict[str, Any] = {
     # same (space, program): preload best-so-far + dedup history +
     # surrogate training set before the first acquisition
     "warm-start": False,
+    # cooperative search (ISSUE 18, docs/STORE.md "Remote store"):
+    # when the store brings in sibling rows at exchange time, also
+    # feed the non-elite (config, qor) rows into the local surrogate's
+    # training set — K cooperating instances train on one pooled
+    # evidence set.  Off disables the federated feed (elite migration
+    # alone still runs)
+    "federate": True,
+    # migration cadence in seconds: minimum interval between store
+    # refreshes (directory re-scan or remote delta pull), which gates
+    # both elite migration and the federated feed
+    "exchange-interval": 2.0,
     # observability plane (docs/OBSERVABILITY.md): a path turns on
     # cross-plane span tracing for the run and writes a
     # Perfetto-viewable Chrome trace there (+ a metrics-snapshot JSONL

@@ -133,9 +133,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "store directory exchange results and "
                         "new-bests (default: ut.temp/store under the "
                         "work dir; pass 'off' to disable)")
-    p.add_argument("--store", choices=("on", "off"), default=None,
-                   help="force the results store on/off regardless of "
-                        "--store-dir ('off' wins over any directory)")
+    p.add_argument("--store", default=None, metavar="MODE|ADDR",
+                   help="'on'/'off' forces the results store regardless "
+                        "of --store-dir ('off' wins over any "
+                        "directory); tcp://HOST:PORT joins a `ut "
+                        "store` cooperative store server instead of a "
+                        "directory — N tuning processes pointed at one "
+                        "server share results, exchange new-bests and "
+                        "pool surrogate evidence over TCP "
+                        "(docs/STORE.md \"Remote store\")")
+    p.add_argument("--federate", choices=("on", "off"), default=None,
+                   help="feed sibling instances' (config, qor) rows "
+                        "into the local surrogate at exchange time "
+                        "(default on; elite migration runs either way)")
+    p.add_argument("--exchange-interval", type=float, default=None,
+                   metavar="SECONDS",
+                   help="migration cadence: minimum seconds between "
+                        "store refreshes gating elite migration and "
+                        "the federated surrogate feed (default 2)")
     p.add_argument("--warm-start", action="store_true", default=None,
                    help="preload this (space, program)'s stored trials "
                         "before the first acquisition: best-so-far, "
@@ -388,12 +403,15 @@ def _merge_replica_bests(cleaned: List[str], n: int,
 #           flight-recorder metrics JSONL (docs/OBSERVABILITY.md)
 #   report  render a tuning journal into a search-quality report
 #   hub     the fleet-telemetry collector --telemetry ships to
+#   store   the cooperative results-store server tuning processes
+#           join with --store tcp://HOST:PORT (docs/STORE.md)
 SUBCOMMANDS = {
     "serve": ("uptune_tpu.serve.cli", "main"),
     "route": ("uptune_tpu.serve.router", "main"),
     "top": ("uptune_tpu.obs.top", "main"),
     "report": ("uptune_tpu.obs.report", "main"),
     "hub": ("uptune_tpu.obs.hub", "main"),
+    "store": ("uptune_tpu.store.server", "main"),
 }
 
 
@@ -540,6 +558,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         if cfg_dir is None or (isinstance(cfg_dir, str)
                                and cfg_dir.lower() in ("off", "none")):
             store_dir = "default"   # ut.temp/store under the work dir
+    elif args.store is not None and args.store != "on":
+        # tcp://HOST:PORT joins a cooperative store server; the addr
+        # IS the store base (wins over any directory — a process
+        # cannot be in two stores)
+        if not args.store.startswith("tcp://"):
+            print(f"ut: --store must be on, off or tcp://HOST:PORT, "
+                  f"got {args.store!r}", file=sys.stderr)
+            return 2
+        from .store.remote import parse_addr
+        try:
+            parse_addr(args.store)
+        except ValueError as e:
+            print(f"ut: {e}", file=sys.stderr)
+            return 2
+        store_dir = args.store
     pt = ProgramTuner(
         [sys.executable, script] + args.script_args, work_dir,
         parallel=args.parallel_factor, test_limit=args.test_limit,
@@ -550,7 +583,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         surrogate_async=args.surrogate_async, template=template,
         seed_configs=seed_cfgs, prefetch=args.prefetch,
         compile_cache_dir=args.compile_cache_dir,
-        store_dir=store_dir, warm_start=args.warm_start)
+        store_dir=store_dir, warm_start=args.warm_start,
+        federate=(None if args.federate is None
+                  else args.federate == "on"),
+        exchange_interval=args.exchange_interval)
 
     if args.cfg:
         for k in sorted(settings):

@@ -659,6 +659,44 @@ class Tuner:
                 sm.maybe_refit()
         return int(len(qor_e))
 
+    def preload_rows(self, rows, refit: bool = True) -> int:
+        """`preload` over result-store row dicts (``cfg``/``qor`` plus
+        optional exact ``u``/``perms``): the ONE row-encoding path the
+        controller's warm start, the cooperative-store federated feed
+        (ISSUE 18), and library callers share.  Rows carrying exact
+        unit vectors matching this space replay bit-exactly; the rest
+        are re-encoded from their configs (close enough for warm-start
+        dedup — a boundary float that re-encodes differently just gets
+        re-measured once)."""
+        rows = [r for r in rows if isinstance(r, dict) and "cfg" in r]
+        if not rows:
+            return 0
+        space = self.space
+        sizes = space.perm_sizes
+
+        def exact(r):
+            u, pp = r.get("u"), r.get("perms")
+            return (u is not None and len(u) == space.n_scalar
+                    and len(pp or []) == len(sizes)
+                    and all(len(p) == s for p, s in zip(pp or [], sizes)))
+
+        ex = [r for r in rows if exact(r)]
+        ap = [r for r in rows if not exact(r)]
+        n = 0
+        if ex:
+            u = np.asarray([r["u"] for r in ex], np.float32)
+            perms = [np.asarray([r["perms"][k] for r in ex], np.int32)
+                     for k in range(len(sizes))]
+            # defer any refit to the LAST preload call of this batch
+            n += self.preload(u, perms, [r["qor"] for r in ex],
+                              refit=refit and not ap)
+        if ap:
+            cb = space.from_configs([r["cfg"] for r in ap])
+            n += self.preload(np.asarray(cb.u),
+                              [np.asarray(p) for p in cb.perms],
+                              [r["qor"] for r in ap], refit=refit)
+        return n
+
     def _log_trial(self, gid, tech, cfg, u_row, perm_rows, qor, is_best,
                    dur) -> None:
         """Append one archive row; `tech` records the proposing technique

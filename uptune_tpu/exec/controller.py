@@ -75,7 +75,9 @@ class ProgramTuner:
                  prefetch: Optional[int] = None,
                  compile_cache_dir: Optional[str] = None,
                  store_dir: Optional[str] = None,
-                 warm_start: Optional[bool] = None):
+                 warm_start: Optional[bool] = None,
+                 federate: Optional[bool] = None,
+                 exchange_interval: Optional[float] = None):
         # seed_configs: known-good configurations injected as 'seed'
         # trials at startup (the reference's --seed-configuration file
         # loading, opentuner/search/driver.py:37-42) — warm-starts
@@ -194,15 +196,29 @@ class ProgramTuner:
         # docs/STORE.md): consulted before every build — a hit serves
         # the recorded QoR through tell() without launching anything;
         # results land back in it as they are measured, and concurrent
-        # instances sharing one directory exchange them.  None resolves
-        # to <work_dir>/ut.temp/store; the literal 'off' disables.
+        # instances sharing one directory — or one tcp:// store server
+        # (ISSUE 18, docs/STORE.md "Remote store") — exchange them.
+        # None resolves to <work_dir>/ut.temp/store; the literal 'off'
+        # disables.
         self.store_dir = (store_dir if store_dir is not None
                           else settings["store-dir"])
         self.warm_start = bool(warm_start if warm_start is not None
                                else settings["warm-start"])
+        # cooperative-search knobs (ISSUE 18): `federate` feeds sibling
+        # (config, qor) rows into the local surrogate's training set at
+        # exchange time (K hosts train one surrogate's worth of
+        # evidence); `exchange_interval` is the migration cadence —
+        # it becomes the store's refresh_interval, the single gate both
+        # the elite-migration and federated-rows flows tick on
+        self.federate = bool(federate if federate is not None
+                             else settings["federate"])
+        self.exchange_interval = float(
+            exchange_interval if exchange_interval is not None
+            else settings["exchange-interval"])
         self.store = None
         self.store_hits = 0        # builds eliminated by cache hits
         self.exchange_injected = 0  # sibling-instance bests ingested
+        self.federated_rows = 0    # sibling rows fed to the surrogate
         # observability: speculative trials withdrawn after a tell()
         # landed a new best (their tickets were proposed around the
         # stale incumbent)
@@ -361,19 +377,22 @@ class ProgramTuner:
     # ------------------------------------------------------------------
     def _open_store(self, space):
         """Open the results store for this (space, command, stage)
-        scope, or return None when disabled ('off')."""
+        scope, or return None when disabled ('off').  A ``tcp://``
+        base opens a `RemoteStore` on a cooperative store server
+        (ISSUE 18); anything else a filesystem `ResultStore`."""
         base = self.store_dir
         if isinstance(base, str) and base.lower() in ("off", "none"):
             return None
         if base is None or (isinstance(base, str)
                             and base.lower() in ("on", "default")):
             base = os.path.join(self.work_dir, "ut.temp", "store")
-        from ..store import ResultStore
+        from ..store import open_store
         extra = ([self.template.path] if self.template is not None
                  else None)
-        return ResultStore(base, [repr(s) for s in space.specs],
-                           self.command, stage=self.stage,
-                           extra_files=extra, env=self.env_extra)
+        return open_store(base, [repr(s) for s in space.specs],
+                          self.command, stage=self.stage,
+                          extra_files=extra, env=self.env_extra,
+                          refresh_interval=self.exchange_interval)
 
     @staticmethod
     def _verdict(qor: Optional[float],
@@ -453,29 +472,7 @@ class ProgramTuner:
                     if REGISTRY.check_qor(r["qor"], r["cfg"])]
         if not rows:
             return 0
-        space = tuner.space
-        sizes = space.perm_sizes
-
-        def exact(r):
-            u, pp = r.get("u"), r.get("perms")
-            return (u is not None and len(u) == space.n_scalar
-                    and len(pp or []) == len(sizes)
-                    and all(len(p) == s for p, s in zip(pp or [], sizes)))
-
-        ex = [r for r in rows if exact(r)]
-        ap = [r for r in rows if not exact(r)]
-        n = 0
-        if ex:
-            u = np.asarray([r["u"] for r in ex], np.float32)
-            perms = [np.asarray([r["perms"][k] for r in ex], np.int32)
-                     for k in range(len(sizes))]
-            n += tuner.preload(u, perms, [r["qor"] for r in ex],
-                               refit=not ap)
-        if ap:
-            cb = space.from_configs([r["cfg"] for r in ap])
-            n += tuner.preload(np.asarray(cb.u),
-                               [np.asarray(p) for p in cb.perms],
-                               [r["qor"] for r in ap])
+        n = tuner.preload_rows(rows)
         res = tuner.result()
         log.info("[ut] warm start: %d stored trials preloaded "
                  "(best=%.6g)", n, res.best_qor)
@@ -507,9 +504,9 @@ class ProgramTuner:
         tuner = self.tuner
         pick = min if self.sense == "min" else max
         row = pick(rows, key=lambda r: float(r["qor"]))
-        if tuner.sign * float(row["qor"]) >= float(tuner.best.qor):
-            return
-        injected = tuner.inject([row["cfg"]], source="exchange")
+        injected = []
+        if tuner.sign * float(row["qor"]) < float(tuner.best.qor):
+            injected = tuner.inject([row["cfg"]], source="exchange")
         if injected:
             self.exchange_injected += len(injected)
             obs.event("store.exchange", qor=float(row["qor"]))
@@ -519,6 +516,34 @@ class ProgramTuner:
                                  qor=round(float(row["qor"]), 6))
             # serve ahead of speculative technique work
             queue.extendleft(reversed(injected))
+        if self.federate:
+            # federated surrogate rows (ISSUE 18): the injected elite
+            # re-enters through its store-hit tell with full
+            # accounting, so feed the REST of the delta to the
+            # surrogate/dedup planes only — K cooperating hosts train
+            # on one pooled evidence set without burning budget trials
+            self._federate_rows([r for r in rows
+                                 if not (injected and r is row)])
+
+    def _federate_rows(self, rows) -> None:
+        """Sibling (config, qor) rows -> the tuner's dedup history +
+        surrogate training set (Tuner.preload_rows): no budget, no
+        archive rows, no bandit credit — foreign evidence, not this
+        run's work.  Refit stays at the surrogate's own versioned-
+        snapshot watermark (maybe_refit): migration cadence must not
+        force a refit storm on K hosts at once."""
+        if not rows:
+            return
+        n = self.tuner.preload_rows(rows, refit=False)
+        if not n:
+            return
+        self.federated_rows += n
+        obs.count("store.federated_rows", n)
+        sm = self.tuner.surrogate
+        if sm is not None:
+            sm.maybe_refit()
+        if obs.journal.enabled():
+            obs.journal.emit("federate", rows=n)
 
     def _host_proposals(self, space) -> List[Trial]:
         """Ask @ut.model proposal sources for one config each."""
@@ -713,9 +738,11 @@ class ProgramTuner:
             if store is not None:
                 log.info(
                     "[ut] store: %d build(s) eliminated by cache hits, "
-                    "%d launched, %d exchange trial(s) ingested (%s)",
+                    "%d launched, %d exchange trial(s) ingested, %d "
+                    "row(s) federated (%s)",
                     self.store_hits, pool.launched,
-                    self.exchange_injected, store.stats())
+                    self.exchange_injected, self.federated_rows,
+                    store.stats())
         res = tuner.result()
         if res.best_config:
             write_best(res.best_config, res.best_qor,

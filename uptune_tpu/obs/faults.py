@@ -25,6 +25,10 @@ Points (the seams future shard-failover work reuses):
   appended (serve/durable.py) — crashing HERE is the
   commit-vs-checkpoint window the bounded-loss contract is about
 * ``store.record`` — a trial row is about to be recorded
+* ``rstore.append`` — the networked store server (store/server.py)
+  is about to durably append an accepted row — crashing HERE is the
+  ack-after-durable window the zero-acked-loss contract is about
+  (``bench.py --store-remote``'s deterministic kill)
 * ``pool.reap``    — a worker-pool build is about to be reaped
 * ``route.spawn``  — the front-tier router is about to spawn (or
   respawn) a shard process (serve/router.py)
@@ -58,7 +62,8 @@ __all__ = ["FaultInjected", "POINTS", "ACTIONS", "armed", "arm",
 ENV_VAR = "UT_FAULTS"
 
 POINTS = ("wire.accept", "wire.read", "wire.reply", "ckpt.append",
-          "store.record", "pool.reap", "route.spawn", "route.kill")
+          "store.record", "rstore.append", "pool.reap", "route.spawn",
+          "route.kill")
 
 ACTIONS = ("crash", "delay", "error")
 

@@ -63,7 +63,8 @@ _STATUS_RANK = {"failing": 0, "stale": 1, "stalled": 2, "cold": 3,
 # the per-source panel's headline counters, shared by the hub's
 # `sources` op and `ut top`'s file-mode panel so the two views can
 # never drift on what a source's "rate" means
-HEADLINE_RATE_KEYS = ("driver.asks", "serve.asks", "serve.tells")
+HEADLINE_RATE_KEYS = ("driver.asks", "serve.asks", "serve.tells",
+                      "store.recorded")   # the ut-store role's rate
 
 
 def window_rates(row: Dict[str, Any]) -> Dict[str, float]:

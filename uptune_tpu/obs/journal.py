@@ -83,6 +83,7 @@ EVENT_KINDS = (
                     # per-trial outcome arrays, credit, incumbent
     "store_hit",    # a build served from the result store
     "exchange",     # a sibling instance's best injected
+    "federate",     # sibling (config, qor) rows fed to the surrogate
     "snapshot",     # surrogate snapshot published
     "feature",      # ut.feature covariates observed by a trial
     "interm",       # ut.interm intermediate feature vector

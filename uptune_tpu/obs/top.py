@@ -190,7 +190,8 @@ def fleet_lines(sources: List[Dict[str, Any]],
     for r in rows:
         rate = r.get("rates") or {}
         main_rate = (rate.get("serve.asks") or rate.get("driver.asks")
-                     or rate.get("serve.tells"))
+                     or rate.get("serve.tells")
+                     or rate.get("store.recorded"))
         flags = []
         if r.get("stale"):
             flags.append("STALE")
@@ -248,10 +249,14 @@ def render(prev: Optional[Sample], cur: Sample, source: str,
             _fmt(g.get("pool.utilization"), nd=2),
             _fmt(r.get("pool.launched")),
             _fmt(_hist_p(h, "pool.build_s", "p95"), nd=2)),
-        "store     hits {}   misses {}   hit-rate {}   "
-        "serve p95 {} ms".format(
+        # recorded/acked-appends light up against a store-server
+        # scrape (`ut top --addr` on a `ut store` process, ISSUE 18)
+        "store     hits {}   misses {}   hit-rate {}   recorded {}   "
+        "acked-appends {}   serve p95 {} ms".format(
             _fmt(hits, nd=0), _fmt(misses, nd=0),
             _fmt(None if hit_rate is None else 100 * hit_rate, "%"),
+            _fmt(c.get("store.recorded"), nd=0),
+            _fmt(c.get("rstore.appends"), nd=0),
             _fmt(_hist_p(h, "store.serve_ms", "p95"), nd=2)),
         "learn     snapshot v{}   refit lag {} rows   "
         "new bests {}".format(

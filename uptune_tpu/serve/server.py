@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from .. import obs
 from ..api.session import settings
 from ..exec.space_io import space_from_params
+from ..store import is_remote_addr, open_store
 from ..store.store import ResultStore
 from .durable import CheckpointLog, decode_raw, default_checkpoint_dir
 from .group import SessionGroup, group_key
@@ -96,7 +97,10 @@ class SessionServer(WireServer):
         self.work_dir = os.path.abspath(work_dir or os.getcwd())
         if sd is None:
             sd = os.path.join(self.work_dir, "ut.serve", "store")
+        # a tcp:// base joins a cooperative store server (ISSUE 18,
+        # docs/STORE.md "Remote store") — an address, not a path
         self.store_dir = (None if str(sd).lower() in ("off", "none")
+                          else str(sd) if is_remote_addr(sd)
                           else os.path.abspath(str(sd)))
         # self._lock (WireServer) guards the registries below too
         self._groups: Dict[Tuple, List[SessionGroup]] = {}
@@ -127,8 +131,11 @@ class SessionServer(WireServer):
         self.recovered = 0
         self.recovery_s = 0.0
         if dv is not None:
-            cdir = (default_checkpoint_dir(self.store_dir,
-                                           self.work_dir)
+            # a remote store base is no place for checkpoint files —
+            # 'on' falls back to the work-dir default then
+            local_sd = (None if is_remote_addr(self.store_dir)
+                        else self.store_dir)
+            cdir = (default_checkpoint_dir(local_sd, self.work_dir)
                     if str(dv).lower() in ("on", "true", "1")
                     else os.path.abspath(str(dv)))
             self.ckpt = CheckpointLog(
@@ -154,8 +161,8 @@ class SessionServer(WireServer):
         # program (and space) share rows; different tokens never
         # collide.  A losing racer's instance never touched disk
         # (the segment opens lazily on first append) — just close it.
-        new = ResultStore(self.store_dir, sig,
-                          ["ut-serve", str(program)])
+        new = open_store(self.store_dir, sig,
+                         ["ut-serve", str(program)])
         with self._lock:
             st = self._stores.get(key)
             if st is None:

@@ -232,6 +232,11 @@ class ResultStore:
         with self._lock:
             return len(self._rows)
 
+    def __bool__(self) -> bool:
+        # An open-but-empty store must stay truthy: ``if store:`` call
+        # sites would otherwise never record the first row.
+        return True
+
     def lookup(self, cfg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         """The recorded row for this config under THIS scope, or None.
         Only successful (finite-QoR) rows are served; failure rows are
