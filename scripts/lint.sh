@@ -40,7 +40,11 @@ fi
 # store/remote.py whose write-behind flusher sits on the tell path of
 # every cooperating tuner), the concurrent
 # background-refit plane (ISSUE 5), the fused/batched engine + Pallas
-# kernels every perf headline rests on (ISSUE 6), the observability
+# kernels every perf headline rests on (ISSUE 6; since ISSUE 19
+# ops/acquire.py fuses surrogate score + acquisition + top-k into
+# the single device program the propose path and BENCH_MULTI's
+# fused-vs-unfused A/B are measured through, routed by
+# ops/routing.py's UT_PALLAS knob), the observability
 # plane whose instrumentation lives INSIDE every hot path (ISSUE 7 —
 # a silenced hazard there would tax or skew the very measurements it
 # exists to make; the ISSUE 10 distributed-obs modules — sidecar,

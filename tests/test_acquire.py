@@ -1,0 +1,317 @@
+"""Fused acquisition pipeline (ops/acquire.py) and the shared
+UT_PALLAS routing knob (ops/routing.py) — ISSUE 19 tier-1.
+
+Parity contract (established empirically; docs/PERF.md):
+
+* interpret route vs XLA-fallback route on the FLAT batch is BITWISE
+  for every kind and for top-k (values and indices): the fallback runs
+  the same per-tile utility function under lax.map over identical
+  tiles, so both routes stage identical computations.
+* kind='mean' is additionally bitwise against the materialized
+  unfused reference (same dot staging).
+* 'ei'/'lcb' differ from the MATERIALIZED reference only by XLA
+  fusion/FMA context (~2e-7): asserted allclose, with top-k INDEX
+  equality (selection-identical) rather than value-bitwise.
+* vmapped comparisons are allclose + index equality: batching changes
+  the gemm reduction shapes, so cross-route bitwise is not promised.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from uptune_tpu.api import session
+from uptune_tpu.ops import acquire, routing
+from uptune_tpu.surrogate import gp
+
+
+# ---------------------------------------------------------------- routing
+class TestRoutingKnob:
+    def test_decide_modes(self):
+        # off: XLA at any size
+        assert routing.decide(1 << 20, mode="off") == routing.XLA
+        # interpret: kernel-in-interpret at any size
+        assert routing.decide(1, mode="interpret") == routing.INTERPRET
+        # auto off-TPU: interpret past min_rows iff cpu_ok
+        assert routing.decide(4096, min_rows=4096,
+                              mode="auto") == routing.INTERPRET
+        assert routing.decide(4095, min_rows=4096,
+                              mode="auto") == routing.XLA
+        assert routing.decide(4096, min_rows=4096, cpu_ok=False,
+                              mode="auto") == routing.XLA
+        # unsupported shapes always fall back
+        assert routing.decide(1 << 20, supported=False,
+                              mode="interpret") == routing.XLA
+
+    def test_env_knob_and_config_precedence(self, monkeypatch):
+        monkeypatch.delenv("UT_PALLAS", raising=False)
+        session.reset_settings()
+        assert routing.pallas_mode() == "auto"
+        session.config({"pallas": "off"})
+        try:
+            assert routing.pallas_mode() == "off"
+            # env wins over ut.config
+            monkeypatch.setenv("UT_PALLAS", "interpret")
+            assert routing.pallas_mode() == "interpret"
+        finally:
+            session.reset_settings()
+
+    def test_bad_values_raise(self, monkeypatch):
+        monkeypatch.setenv("UT_PALLAS", "fast")
+        with pytest.raises(ValueError):
+            routing.pallas_mode()
+        monkeypatch.delenv("UT_PALLAS", raising=False)
+        session.reset_settings()
+        session.config({"pallas": "sometimes"})   # keys checked here
+        try:
+            with pytest.raises(ValueError):
+                routing.pallas_mode()             # values at read time
+        finally:
+            session.reset_settings()
+
+    def test_interpret_flag(self):
+        assert routing.interpret_flag(routing.INTERPRET) is True
+        assert routing.interpret_flag(routing.PALLAS) is False
+
+
+# ---------------------------------------------------------------- fixtures
+def _dense_state():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(48, 6), jnp.float32)
+    y = jnp.asarray(rng.randn(48), jnp.float32)
+    st = gp.precompute_kinv(gp.fit(x, y))
+    return st, float(np.asarray(y).min()), None, 0
+
+
+def _mixed_state():
+    rng = np.random.RandomState(1)
+    n_cont, n_cat, K = 3, 4, 3
+    codes = rng.randint(K, size=(56, n_cat))
+    oh = np.zeros((56, n_cat, K), np.float32)
+    np.put_along_axis(oh, codes[:, :, None], 1.0, axis=2)
+    x = np.concatenate(
+        [rng.rand(56, n_cont).astype(np.float32),
+         oh.reshape(56, -1) / np.sqrt(2)], axis=1)
+    y = (x[:, 0] + 2.0 * (codes[:, 1] == 0)
+         + 0.1 * rng.randn(56)).astype(np.float32)
+    st = gp.precompute_kinv(gp.fit(
+        jnp.asarray(x), jnp.asarray(y), 0.4, 1e-2,
+        n_cont=n_cont, n_cat=n_cat, ls_cat=0.2))
+    return st, float(y.min()), n_cont, n_cat
+
+
+@pytest.fixture(scope="module", params=["dense", "mixed"])
+def fitted(request):
+    st, best, nc, ncat = (_dense_state() if request.param == "dense"
+                          else _mixed_state())
+    rng = np.random.RandomState(2)
+    xq = jnp.asarray(rng.rand(200, st.x.shape[1]), jnp.float32)
+    return st, best, nc, ncat, xq
+
+
+def _kw(kind, best):
+    return {"kind": kind, "best_y": best if kind == "ei" else None}
+
+
+# ---------------------------------------------------------------- parity
+class TestFlatParity:
+    @pytest.mark.parametrize("kind", acquire.KINDS)
+    def test_interpret_equals_xla_bitwise(self, fitted, kind):
+        st, best, nc, ncat, xq = fitted
+        u_i = acquire.acquire_scores(st, xq, n_cont=nc, n_cat=ncat,
+                                     route=routing.INTERPRET,
+                                     **_kw(kind, best))
+        u_x = acquire.acquire_scores(st, xq, n_cont=nc, n_cat=ncat,
+                                     route=routing.XLA,
+                                     **_kw(kind, best))
+        np.testing.assert_array_equal(np.asarray(u_i), np.asarray(u_x))
+
+    def test_mean_bitwise_vs_materialized_ref(self, fitted):
+        st, best, nc, ncat, xq = fitted
+        ref = acquire.acquire_scores_ref(st, xq, kind="mean",
+                                         n_cont=nc, n_cat=ncat)
+        for route in (routing.INTERPRET, routing.XLA):
+            got = acquire.acquire_scores(st, xq, kind="mean",
+                                         n_cont=nc, n_cat=ncat,
+                                         route=route)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(ref))
+
+    @pytest.mark.parametrize("kind", ["ei", "lcb"])
+    def test_ei_lcb_close_to_ref_and_selection_identical(
+            self, fitted, kind):
+        """ei/lcb vs the MATERIALIZED pipeline: only FMA/fusion noise
+        (<=~2e-7), and the fused top-k picks the same candidates."""
+        st, best, nc, ncat, xq = fitted
+        ref = acquire.acquire_scores_ref(st, xq, n_cont=nc, n_cat=ncat,
+                                         **_kw(kind, best))
+        got = acquire.acquire_scores(st, xq, n_cont=nc, n_cat=ncat,
+                                     route=routing.INTERPRET,
+                                     **_kw(kind, best))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=2e-6)
+        _, ri = acquire.acquire_topk_ref(st, xq, 7, n_cont=nc,
+                                         n_cat=ncat, **_kw(kind, best))
+        _, gi = acquire.acquire_topk(st, xq, 7, n_cont=nc, n_cat=ncat,
+                                     route=routing.INTERPRET,
+                                     **_kw(kind, best))
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(ri))
+
+    def test_utilities_orientation(self, fitted):
+        """'mean' utilities are exactly -posterior-mean: descending
+        utility = ascending predicted QoR."""
+        st, best, nc, ncat, xq = fitted
+        u = acquire.acquire_scores(st, xq, kind="mean", n_cont=nc,
+                                   n_cat=ncat, route=routing.XLA)
+        mu, _ = gp.predict(st, xq, nc, ncat) if nc is not None else \
+            gp.predict(st, xq)
+        # predict solves through the Cholesky (different staging):
+        # same tolerance as the pallas_score-vs-predict tests
+        np.testing.assert_allclose(np.asarray(u), -np.asarray(mu),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTopK:
+    @pytest.mark.parametrize("k", [1, 5, 160])
+    def test_topk_interpret_equals_xla_bitwise(self, fitted, k):
+        st, best, nc, ncat, xq = fitted
+        vi, ii = acquire.acquire_topk(st, xq, min(k, xq.shape[0]),
+                                      kind="ei", best_y=best,
+                                      n_cont=nc, n_cat=ncat,
+                                      route=routing.INTERPRET)
+        vx, ix = acquire.acquire_topk(st, xq, min(k, xq.shape[0]),
+                                      kind="ei", best_y=best,
+                                      n_cont=nc, n_cat=ncat,
+                                      route=routing.XLA)
+        np.testing.assert_array_equal(np.asarray(vi), np.asarray(vx))
+        np.testing.assert_array_equal(np.asarray(ii), np.asarray(ix))
+
+    def test_topk_matches_global_topk_semantics(self, fitted):
+        """(vals, idx) == lax.top_k over the full utility vector —
+        descending values, ties to the LOWEST flat index."""
+        st, best, nc, ncat, xq = fitted
+        u = acquire.acquire_scores(st, xq, kind="lcb", n_cont=nc,
+                                   n_cat=ncat, route=routing.INTERPRET)
+        rv, ri = jax.lax.top_k(u, 9)
+        gv, gi = acquire.acquire_topk(st, xq, 9, kind="lcb",
+                                      n_cont=nc, n_cat=ncat,
+                                      route=routing.INTERPRET)
+        np.testing.assert_array_equal(np.asarray(gv), np.asarray(rv))
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(ri))
+
+    def test_topk_ties_take_lowest_index(self):
+        """Duplicated query rows produce exactly equal utilities; the
+        streaming selection must resolve ties like lax.top_k (lowest
+        global index), not arbitrarily per tile."""
+        st, best, nc, ncat = _dense_state()
+        rng = np.random.RandomState(3)
+        base = rng.rand(4, 6).astype(np.float32)
+        xq = jnp.asarray(np.tile(base, (8, 1)))      # each row x8
+        _, idx = acquire.acquire_topk(st, xq, 8, kind="mean",
+                                      route=routing.INTERPRET)
+        u = acquire.acquire_scores_ref(st, xq, kind="mean")
+        _, ref_idx = jax.lax.top_k(u, 8)
+        np.testing.assert_array_equal(np.asarray(idx),
+                                      np.asarray(ref_idx))
+
+    @pytest.mark.slow
+    def test_topk_spill_k_beyond_tile_width(self):
+        """k > TILE (per-tile selection saturates at TILE winners and
+        the cross-tile merge must recover the global set): bitwise
+        interpret==xla and exact vs the materialized global top-k.
+        Slow-marked (~15s: a 3-tile interpret-mode kernel); the k <=
+        TILE merge path stays tier-1 above."""
+        st, best, nc, ncat = _dense_state()
+        rng = np.random.RandomState(4)
+        xq = jnp.asarray(rng.rand(2500, 6), jnp.float32)
+        k = 1200
+        vi, ii = acquire.acquire_topk(st, xq, k, kind="lcb",
+                                      route=routing.INTERPRET)
+        vx, ix = acquire.acquire_topk(st, xq, k, kind="lcb",
+                                      route=routing.XLA)
+        np.testing.assert_array_equal(np.asarray(vi), np.asarray(vx))
+        np.testing.assert_array_equal(np.asarray(ii), np.asarray(ix))
+        u = acquire.acquire_scores_ref(st, xq, kind="lcb")
+        rv, ri = jax.lax.top_k(u, k)
+        np.testing.assert_allclose(np.asarray(vi), np.asarray(rv),
+                                   rtol=1e-5, atol=2e-6)
+
+    def test_k_validation(self):
+        st, best, *_ = _dense_state()
+        xq = jnp.zeros((16, 6), jnp.float32)
+        with pytest.raises(ValueError):
+            acquire.acquire_topk(st, xq, 0)
+        with pytest.raises(ValueError):
+            acquire.acquire_topk(st, xq, 17)
+        with pytest.raises(ValueError):
+            acquire.acquire_scores(st, xq, kind="ei")   # best_y
+        with pytest.raises(ValueError):
+            acquire.acquire_scores(st, xq, kind="nope")
+
+
+# ---------------------------------------------------------------- batched
+class TestBatchedParity:
+    def test_vmapped_routes_agree(self, fitted):
+        """vmap over an instance axis: both routes select the same
+        candidates per instance (values allclose; batching changes
+        gemm shapes, so bitwise is out of contract here)."""
+        st, best, nc, ncat, xq = fitted
+        stack = xq[:192].reshape(2, 96, -1)
+
+        def tk(route):
+            return jax.vmap(lambda q: acquire.acquire_topk(
+                st, q, 6, kind="ei", best_y=best, n_cont=nc,
+                n_cat=ncat, route=route))(stack)
+
+        vi, ii = tk(routing.INTERPRET)
+        vx, ix = tk(routing.XLA)
+        np.testing.assert_array_equal(np.asarray(ii), np.asarray(ix))
+        np.testing.assert_allclose(np.asarray(vi), np.asarray(vx),
+                                   rtol=1e-5, atol=2e-6)
+
+    def test_shard_mapped_equals_vmapped(self):
+        """shard_map over the instance mesh wrapping the vmapped fused
+        top-k is semantically invisible (same selections as plain
+        vmap on one device)."""
+        from jax.sharding import PartitionSpec as P
+
+        from uptune_tpu.engine import make_instance_mesh
+        from uptune_tpu.parallel.sharded import shard_map
+
+        st, best, nc, ncat = _dense_state()
+        rng = np.random.RandomState(5)
+        stack = jnp.asarray(rng.rand(4, 64, 6), jnp.float32)
+
+        def local(qs):
+            return jax.vmap(lambda q: acquire.acquire_topk(
+                st, q, 5, kind="lcb", route=routing.XLA))(qs)
+
+        mesh = make_instance_mesh(2)
+        sharded = jax.jit(shard_map(
+            local, mesh=mesh, in_specs=(P("idev"),),
+            out_specs=P("idev"), check_rep=False))
+        vv, vs = jax.jit(local)(stack), sharded(stack)
+        np.testing.assert_array_equal(np.asarray(vv[1]),
+                                      np.asarray(vs[1]))
+        np.testing.assert_allclose(np.asarray(vv[0]),
+                                   np.asarray(vs[0]),
+                                   rtol=1e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------- schema
+class TestKernelSchema:
+    def test_fields_and_vmem_budget(self):
+        sch = acquire.kernel_schema(1024, 16, kind="ei", k=64)
+        assert sch["tile_rows"] == acquire.TILE
+        assert sch["lanes"] == acquire.LANES
+        assert sch["k_lanes"] == 128            # ceil(64 -> KLANES)
+        assert sch["min_rows_auto"] == acquire.MIN_ROWS
+        # VMEM residency stays inside a v4/v5 core's ~16 MB budget at
+        # the documented worst-case protocol shape (docs/PERF.md)
+        assert sch["vmem_bytes"] < 16 * 1024 * 1024
+        # mean drops the kinv/w blocks
+        assert acquire.kernel_schema(1024, 16, kind="mean",
+                                     k=0)["vmem_bytes"] < \
+            sch["vmem_bytes"]

@@ -633,7 +633,10 @@ def test_package_suppression_free(package):
     refit thread (ISSUE 5) — a silenced host-sync or retrace hazard
     there would hide a stall on the very path this PR moved off the
     driver; engine/ and ops/ carry the fused/batched acquisition loop
-    and its Pallas kernels (ISSUE 6) — a silenced hazard there would
+    and its Pallas kernels (ISSUE 6; since ISSUE 19 ops/acquire.py
+    fuses surrogate score + acquisition + top-k into one device
+    program on the propose path, routed by ops/routing.py's UT_PALLAS
+    knob) — a silenced hazard there would
     invalidate every BENCH_* headline measured through them; obs/ is
     instrumentation living INSIDE every hot path (ISSUE 7; the
     ISSUE 10 distributed-obs modules — sidecar, flight recorder,

@@ -103,6 +103,16 @@ DEFAULTS: Dict[str, Any] = {
     # between refits stays on in both modes — disable it via
     # surrogate_opts={'incremental': False})
     "surrogate-async": None,
+    # Pallas kernel routing (ops/routing.py): 'auto' (None = default)
+    # routes each kernel site by backend + shape qualification — the
+    # compiled TPU kernel, the interpret-mode kernel on CPU where the
+    # site opts in, the XLA fallback otherwise; 'interpret' forces the
+    # kernel route in interpret mode wherever shapes are supported
+    # (debugging/CI: kernel math everywhere, any host); 'off' forces
+    # the XLA fallback everywhere (bisection).  Layered UNDER the
+    # UT_PALLAS env var (env wins — the knob must be forceable on a
+    # subprocess without touching its code)
+    "pallas": None,
     # tuning-as-a-service session server (`ut serve`, docs/SERVING.md).
     # Same precedence contract as every other key: CLI flags >
     # ut.config(...) > these defaults.

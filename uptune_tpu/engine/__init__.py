@@ -1,3 +1,5 @@
-from .batched import (BatchedEngine, exchange_best,  # noqa: F401
-                      make_instance_mesh, surrogate_eval_fn)
+from .batched import (BatchedEngine, StatefulEval,  # noqa: F401
+                      exchange_best, exchange_topk,
+                      make_instance_mesh, surrogate_aux,
+                      surrogate_eval_fn)
 from .fused import DeviceObjective, EngineState, FusedEngine, default_arms  # noqa: F401

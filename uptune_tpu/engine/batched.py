@@ -97,17 +97,70 @@ def exchange_best(best: Best, axes) -> Best:
         qmin)
 
 
+class StatefulEval:
+    """An eval_fn whose learned state rides as a PROGRAM ARGUMENT.
+
+    The pre-ISSUE-19 pattern — a fresh closure over `gp_state` per
+    surrogate snapshot — retraced the whole fused-propose program on
+    every publish (`jit_run` memoizes by eval_fn object identity, and
+    the captured state was baked into the jaxpr as a constant).  Here
+    the pure function `fn(cands, aux)` is built ONCE per engine/config
+    and the snapshot pytree lives in `.aux`: `jit_run` threads the
+    CURRENT `.aux` as a (non-donated) argument on every dispatch, so a
+    publish is one attribute rebind — same structure/shapes, zero
+    retrace under UT_TRACE_GUARD=strict (the tier-1 regression).
+
+    `topk(cands, aux, k) -> (vals [k], idx [k])` is the fused
+    score+acquisition+top-k companion (ops/acquire) the slot programs
+    vmap per instance; `__call__` keeps the legacy eager contract
+    (scores the batch against the CURRENT aux)."""
+    __slots__ = ("fn", "topk", "aux")
+
+    def __init__(self, fn, aux, topk=None):
+        self.fn, self.aux, self.topk = fn, aux, topk
+
+    def __call__(self, cands: CandBatch) -> jax.Array:
+        return self.fn(cands, self.aux)
+
+    def publish(self, aux) -> None:
+        """Swap in a new snapshot.  The aux pytree MUST keep the same
+        structure and shapes (same train-size bucket, K^-1 attached
+        consistently) — that is what makes this retrace-free."""
+        self.aux = aux
+
+
+def surrogate_aux(gp_state, best_y=None, kind: str = "ei"):
+    """The aux pytree for `surrogate_eval_fn` programs: (GPState with
+    the premasked K^-1 attached for variance kinds, best-so-far as a
+    traced f32 scalar).  Build the refit's aux with the SAME kind and
+    train-size bucket and publish via `ev.publish(surrogate_aux(...))`."""
+    from ..surrogate import gp as gp_mod
+    if kind != "mean" and gp_state.kinv is None:
+        gp_state = gp_mod.precompute_kinv(gp_state)
+    return (gp_state,
+            jnp.asarray(0.0 if best_y is None else best_y, jnp.float32))
+
+
 def surrogate_eval_fn(space, gp_state, kind: str = "ei",
                       best_y=None, beta: float = 2.0,
                       n_cont: Optional[int] = None, n_cat: int = 0,
-                      sense: str = "min"):
+                      sense: str = "min", impl: str = "fused"):
     """A flat-batch eval_fn scoring candidates against a fitted
     GPState so that the ENGINE prefers: low posterior mean ('mean'),
     high expected improvement ('ei'), or low mu - beta*sd ('lcb').
     Because BatchedEngine evaluates the FLATTENED [N*B] batch, all
-    instances share one scoring pass — one [N*B, train] cross-kernel
-    matmul (Pallas-tiled past PALLAS_MIN_POOL) instead of N separate
-    dispatches.
+    instances share one scoring pass — and with impl='fused' (default)
+    that pass is the ISSUE-19 fused acquisition pipeline
+    (`ops/acquire`): cross-kernel, moments, and the acquisition
+    transform in ONE device program (Pallas kernel / XLA fallback per
+    `ops/routing.py`), no [N*B, train] or [N*B] HBM intermediates.
+    impl='score_flat' keeps the pre-fusion `gp.score_flat` staging
+    (the A/B comparator).
+
+    Returns a `StatefulEval`: the GP snapshot and best-so-far ride in
+    `.aux` as program arguments — publish a refit with
+    `ev.publish(surrogate_aux(new_state, new_best, kind))` and no
+    compiled program retraces.
 
     `sense` MUST match the engine's: eval_fn output is re-oriented by
     commit (`qor = sign * raw` — the eval_fn slot carries USER-level
@@ -115,16 +168,65 @@ def surrogate_eval_fn(space, gp_state, kind: str = "ei",
     assumed fitted on engine-oriented (minimized) QoR, as the driver
     trains it."""
     assert sense in ("min", "max"), sense
+    if impl not in ("fused", "score_flat"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if kind == "ei" and best_y is None:
+        raise ValueError("kind='ei' needs best_y")
     sgn = 1.0 if sense == "min" else -1.0
+    from ..ops import acquire as acq_mod
     from ..surrogate import gp as gp_mod
 
-    def eval_fn(cands: CandBatch) -> jax.Array:
-        feats = space.surrogate_transform(space.features(cands))
-        s = gp_mod.score_flat(gp_state, feats, kind=kind, best_y=best_y,
-                              beta=beta, n_cont=n_cont, n_cat=n_cat)
+    def _feats(cands: CandBatch) -> jax.Array:
+        return space.surrogate_transform(space.features(cands))
+
+    def fn(cands: CandBatch, aux) -> jax.Array:
+        st, by = aux
+        if impl == "fused":
+            u = acq_mod.acquire_scores(
+                st, _feats(cands), kind=kind,
+                best_y=by if kind == "ei" else None,
+                beta=beta, n_cont=n_cont, n_cat=n_cat)
+            # utilities are higher-is-better; IEEE negation is exact,
+            # so sense orientation stays bitwise-symmetric
+            return sgn * (-u)
+        s = gp_mod.score_flat(
+            st, _feats(cands), kind=kind,
+            best_y=by if kind == "ei" else None,
+            beta=beta, n_cont=n_cont, n_cat=n_cat)
         return sgn * (-s if kind == "ei" else s)
 
-    return eval_fn
+    def topk(cands: CandBatch, aux, k: int):
+        st, by = aux
+        return acq_mod.acquire_topk(
+            st, _feats(cands), k, kind=kind,
+            best_y=by if kind == "ei" else None,
+            beta=beta, n_cont=n_cont, n_cat=n_cat)
+
+    return StatefulEval(fn, surrogate_aux(gp_state, best_y, kind),
+                        topk=topk)
+
+
+def exchange_topk(vals: jax.Array, idx: jax.Array, axes, k: int):
+    """Portfolio-wide top-k across the named instance axes (vmap
+    and/or mesh — the exchange_best axis contract): every instance
+    contributes its local fused top-k (vals [k] desc, idx [k]), a
+    one-hot-style scatter + psum assembles the [n_total, k] pool in
+    row-major rank order, and one lax.top_k over the flattened pool
+    broadcasts the SAME global winners to every instance.  Ties
+    resolve by (rank, local rank) — the flat-pool lowest-index order.
+    Returns (vals [k], owner rank [k] i32, local idx [k] i32)."""
+    axes = tuple(axes)
+    n_total, rank = 1, jnp.asarray(0, jnp.int32)
+    for ax in axes:  # row-major rank, exactly as exchange_best
+        sz = jax.lax.psum(1, ax)
+        n_total, rank = n_total * sz, rank * sz + jax.lax.axis_index(ax)
+    gv = jax.lax.psum(
+        jnp.zeros((n_total, k), vals.dtype).at[rank].set(vals), axes)
+    gi = jax.lax.psum(
+        jnp.zeros((n_total, k), jnp.int32).at[rank].set(
+            idx.astype(jnp.int32)), axes)
+    v, pos = jax.lax.top_k(gv.reshape(-1), k)
+    return v, (pos // k).astype(jnp.int32), gi.reshape(-1)[pos]
 
 
 class BatchedEngine:
@@ -230,15 +332,48 @@ class BatchedEngine:
         updates the stacked histories/technique states in place — the
         caller must rebind and never reuse the donated input.
 
-        `eval_fn` is part of the memo key by OBJECT IDENTITY (same
-        contract as jax.jit): pass the SAME callable across calls.
-        Re-wrapping a fresh closure per call (e.g. a new
-        surrogate_eval_fn every refit) recompiles each time and the
-        memo retains every compiled program plus whatever the closure
-        captured."""
-        sig = (n_steps, donate, eval_fn)
+        A plain-callable `eval_fn` is part of the memo key by OBJECT
+        IDENTITY (same contract as jax.jit): pass the SAME callable
+        across calls — re-wrapping a fresh closure per call recompiles
+        each time.  A `StatefulEval` is keyed by its pure `.fn` and its
+        `.aux` snapshot is threaded as a non-donated program ARGUMENT,
+        read at call time: publishing a refit (`.publish(...)`, same
+        pytree structure/shapes) re-dispatches the one compiled program
+        and NEVER retraces (the UT_TRACE_GUARD=strict regression)."""
+        stateful = isinstance(eval_fn, StatefulEval)
+        sig = (n_steps, donate, eval_fn.fn if stateful else eval_fn)
         fn = self._compiled.get(sig)
         if fn is not None:
+            return fn
+        if stateful:
+            if self.mesh is None:
+                def _run(s, aux):
+                    return self._run_local(
+                        s, n_steps, (VMAP_AXIS,),
+                        lambda c: eval_fn.fn(c, aux))
+            else:
+                from ..parallel.sharded import shard_map
+
+                def _local(s, aux):
+                    return self._run_local(
+                        s, n_steps, (MESH_AXIS, VMAP_AXIS),
+                        lambda c: eval_fn.fn(c, aux))
+
+                # aux is replicated (P() prefix spec): every shard
+                # scores against the same snapshot
+                _run = shard_map(_local, mesh=self.mesh,
+                                 in_specs=(P(MESH_AXIS), P()),
+                                 out_specs=P(MESH_AXIS), check_rep=False)
+            inst = obs.instrument_device_fn(
+                jax.jit(_run, donate_argnums=(0,) if donate else ()),
+                "engine.batched_run", steps=n_steps,
+                n_instances=self.n_instances, donate=donate)
+
+            def fn(state, aux=None):
+                return inst(state, _strong(
+                    eval_fn.aux if aux is None else aux))
+            fn.lower = inst.lower  # AOT/bench: pass aux explicitly
+            self._compiled[sig] = fn
             return fn
         if self.mesh is None:
             def _run(s):
@@ -246,11 +381,11 @@ class BatchedEngine:
         else:
             from ..parallel.sharded import shard_map
 
-            def _local(s):
+            def _run_l(s):
                 return self._run_local(s, n_steps,
                                        (MESH_AXIS, VMAP_AXIS), eval_fn)
 
-            _run = shard_map(_local, mesh=self.mesh,
+            _run = shard_map(_run_l, mesh=self.mesh,
                              in_specs=(P(MESH_AXIS),),
                              out_specs=P(MESH_AXIS), check_rep=False)
         fn = obs.instrument_device_fn(
@@ -314,6 +449,96 @@ class BatchedEngine:
             fn = self._compiled["propose_all"] = obs.instrument_device_fn(
                 jax.jit(_propose_all), "engine.propose_all",
                 n_instances=self.n_instances)
+        return fn
+
+    def jit_propose_topk(self, k: int, acq):
+        """Jitted (state, aux) -> (tstates, cands, keys, vals [n, k],
+        idx [n, k]): one proposal epoch plus the fused per-slot top-k
+        (StatefulEval `acq` with a `.topk` — surrogate_eval_fn
+        impl="fused") in a single dispatch.  The serving plane uses
+        this to hand each tenant only its k best-by-acquisition rows
+        instead of the full proposal batch.  Memoized by (k, acq.fn);
+        aux (the surrogate snapshot) is a program argument, so refits
+        published via acq.publish never retrace.  Unsharded-only, like
+        every slot primitive."""
+        if self.mesh is not None:
+            raise ValueError("slot primitives are unsharded-only")
+        if acq.topk is None:
+            raise ValueError("acq has no topk (need impl='fused')")
+        sig = ("propose_topk", k, acq.fn)
+        fn = self._compiled.get(sig)
+        if fn is not None:
+            return fn
+
+        def _propose_topk(s, aux):
+            tstates, cands, keys = jax.vmap(self.engine.propose)(s)
+            vals, idx = jax.vmap(lambda c: acq.topk(c, aux, k))(cands)
+            return tstates, cands, keys, vals, idx
+
+        inst = obs.instrument_device_fn(
+            jax.jit(_propose_topk), "engine.propose_topk", k=k,
+            n_instances=self.n_instances)
+
+        def fn(state, aux=None):
+            return inst(state, _strong(acq.aux if aux is None else aux))
+        fn.lower = inst.lower
+        self._compiled[sig] = fn
+        return fn
+
+    def jit_global_topk(self, k: int, acq):
+        """Jitted (state, aux) -> (vals, owner, idx), each [n_local, k]
+        with IDENTICAL rows: one proposal epoch, the fused per-instance
+        top-k, then the exchange_topk collective merging the [n*B]
+        global candidate pool's k best across the vmap (and, when
+        sharded, mesh) instance axes.  `owner` is the flattened
+        row-major instance rank that proposed each winner and `idx` its
+        row within that instance's batch.  Memoized by (k, acq.fn);
+        aux is a replicated program argument (no retrace on publish)."""
+        if acq.topk is None:
+            raise ValueError("acq has no topk (need impl='fused')")
+        sig = ("global_topk", k, acq.fn)
+        fn = self._compiled.get(sig)
+        if fn is not None:
+            return fn
+        axes = ((VMAP_AXIS,) if self.mesh is None
+                else (MESH_AXIS, VMAP_AXIS))
+
+        def _local(s, aux):
+            def one(si):
+                _, cands, _ = self.engine.propose(si)
+                vals, idx = acq.topk(cands, aux, k)
+                # The per-instance tops are returned alongside the
+                # exchange result (and sliced off in the host wrapper):
+                # keeping them live as program outputs pins the
+                # collective's operands to committed buffers.  With only
+                # the exchanged [k] arrays as outputs, the emulated
+                # multi-CPU-device backend (forced virtual devices) has
+                # been observed to feed the all-reduce stale operand
+                # rows — values absent from any instance's score vector
+                # — at mesh=2 with 2 instances per shard; any
+                # observation of vals/idx (outputs, debug.print)
+                # restores the correct result, and optimization_barrier
+                # alone does not.
+                return vals, idx, exchange_topk(vals, idx, axes, k)
+            return jax.vmap(one, axis_name=VMAP_AXIS)(s)
+
+        if self.mesh is None:
+            _prog = _local
+        else:
+            from ..parallel.sharded import shard_map
+            _prog = shard_map(_local, mesh=self.mesh,
+                              in_specs=(P(MESH_AXIS), P()),
+                              out_specs=P(MESH_AXIS), check_rep=False)
+        inst = obs.instrument_device_fn(
+            jax.jit(_prog), "engine.global_topk", k=k,
+            n_instances=self.n_instances)
+
+        def fn(state, aux=None):
+            _, _, ex = inst(state,
+                            _strong(acq.aux if aux is None else aux))
+            return ex
+        fn.lower = inst.lower
+        self._compiled[sig] = fn
         return fn
 
     def jit_init_slot(self):

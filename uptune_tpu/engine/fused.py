@@ -119,6 +119,23 @@ class FusedEngine:
                  else cands_list[0])
         return tuple(new_tstates), cands, key
 
+    def propose_topk(self, state: EngineState, acq, k: int):
+        """Propose one epoch and keep only the k rows the fused
+        acquisition pipeline ranks best (pure; jit-able).  `acq` is a
+        `StatefulEval` from `surrogate_eval_fn(..., impl="fused")` —
+        its `.topk` runs surrogate score, acquisition transform and
+        top-k selection in one device program over the proposal batch
+        (ops/acquire.py).  Returns `(new_tstates, cands, key, vals,
+        idx)` with `vals`/`idx` the [k] acquisition utilities and
+        candidate rows; the caller gathers `cands[idx]` (or feeds the
+        indices to a measurement queue) instead of materialising
+        per-row scores."""
+        if acq.topk is None:
+            raise ValueError("acq has no topk (need impl='fused')")
+        new_tstates, cands, key = self.propose(state)
+        vals, idx = acq.topk(cands, acq.aux, k)
+        return new_tstates, cands, key, vals, idx
+
     # ------------------------------------------------------------------
     def step(self, state: EngineState, eval_fn=None,
              exchange=None) -> EngineState:

@@ -1,2 +1,7 @@
 """Batched operator kernels over the flat space encoding."""
-from . import numeric, perm  # noqa: F401
+# NOTE: ops.acquire is deliberately NOT imported here — it imports
+# surrogate.pallas_score (shared tile math), and surrogate/__init__
+# imports ops.perm via the manager, so pulling acquire at package init
+# would close an import cycle.  Consumers import uptune_tpu.ops.acquire
+# directly.
+from . import numeric, perm, routing  # noqa: F401

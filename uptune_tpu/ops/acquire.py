@@ -1,0 +1,472 @@
+"""Fused acquisition pipeline: surrogate score + acquisition transform
++ streaming top-k as ONE device program over a flat candidate batch.
+
+The propose hot path used to be three dispatches with HBM round trips
+between them: `surrogate/pallas_score.py` produced mu/sd `[B]`, the
+acquisition transform (EI / LCB / mean) read them back, and selection
+ran `argsort`/`top_k` over the `[B]` score vector.  At north-star batch
+sizes (`[N*B]` flat rows from the batched engine, ISSUE 19) those
+intermediates are pure HBM traffic: every value the pipeline ships
+between stages is recomputable inside the tile that produced it.
+
+This module collapses the pipeline.  Each grid step loads one
+`[TILE, F]` candidate tile plus the `[N, F]` train block, `alpha`, and
+the premasked `K^-1` into VMEM, computes the cross-kernel tile, the
+posterior moments (the `pallas_score` quadratic-form tiling), the
+acquisition UTILITY (higher = better), and — in the top-k variant — a
+streaming per-tile selection, writing only `[TILE]` utilities or
+`[KPAD]` (value, index) lanes per tile.  Nothing of size `[B, N]` or
+even `[B]` crosses HBM between stages.
+
+Route selection follows the `ops/dedup.py` precedent via
+`ops/routing.py` (`UT_PALLAS` / `ut.config('pallas')`): the compiled
+kernel on TPU past `MIN_ROWS` and the single-program XLA fallback
+everywhere else — including CPU in auto mode (`cpu_ok=False`, like
+dedup's merge: at the bench shape the fallback beats the pre-fusion
+staging ~1.1x while the interpret-mode emulation loses ~8%, so auto
+must not pay the emulator for production CPU runs).  Force
+`UT_PALLAS=interpret` to exercise kernel math on any host.  The
+fallback runs the SAME tile function under `lax.map` — identical
+shapes, identical op sequence per tile — so kernel-vs-fallback parity
+is bitwise by construction, not by tolerance (tier-1 tested).
+
+Top-k semantics match `lax.top_k` exactly: values descending, ties
+broken by the LOWEST flat candidate index.  The kernel selects
+`min(k, TILE)` local winners per tile by repeated max + lowest-index
+tie-break, then one tiny `[n_tiles * KPAD]` merge outside the grid
+reproduces the global order (each tile's winners are emitted in
+(value desc, index asc) order and tiles concatenate in index order, so
+the merge's positional tie-break equals the global index tie-break).
+
+VMEM budget per grid step (f32, the mean+variance kinds): the
+candidate tile `4*TILE*F`, train block `4*N*F`, `K^-1` `4*N*N`, and
+two `[TILE, N]` intermediates — at TILE=1024, N=1024, F<=64 that is
+~12.6 MB, the same envelope `pallas_score._mean_var_padded` already
+ships under the 16 MB/core budget (docs/PERF.md "Fused acquisition
+pipeline").
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import routing
+# math helpers are SHARED with the scoring kernel (bitwise contract);
+# pallas_score imports nothing from ops, so this edge is acyclic —
+# but ops/__init__ must NOT import this module (surrogate/manager
+# imports ops.perm at package init)
+from ..surrogate.pallas_score import (PALLAS_MIN_POOL, ROWS, VLANES,
+                                      VTILE, _matern_tile, _tile_d2)
+
+LANES = VLANES        # 128-lane output width (scores variant)
+TILE = VTILE          # 1024 candidate rows per grid step
+KLANES = 128          # top-k output lane quantum (KPAD = ceil to this)
+MIN_ROWS = PALLAS_MIN_POOL  # auto-route threshold, shared with scoring
+
+KINDS = ("mean", "ei", "lcb")
+
+
+# ---------------------------------------------------------- tile math
+def _ei_transform(mu, sd, best_y, beta):
+    from ..surrogate import gp as _gp  # lazy: gp imports nothing of ops
+    return _gp.ei_from_moments(mu, sd, best_y)
+
+
+def _lcb_transform(mu, sd, best_y, beta):
+    return -(mu - beta * sd)
+
+
+# static-kind dispatch (bound at trace time; 'mean' short-circuits on
+# its missing kinv before the transform is reached)
+_TRANSFORM = {"ei": _ei_transform, "lcb": _lcb_transform}
+
+
+def _utility_tile(qc, qk, xc, xk, alpha, kinv, params, kind: str):
+    """Acquisition utility (higher = better) for ONE candidate tile, as
+    a (ROWS, LANES) block — the single source of math for BOTH the
+    Pallas kernel body and the XLA fallback (bitwise parity rests on
+    this sharing).  `params` is the (1, 8) scalar pack (anything
+    supporting [0, j] reads: an SMEM ref in-kernel, a jnp array in the
+    fallback); `kinv` is the premasked K^-1 (None for kind='mean',
+    which needs no variance)."""
+    if qc is None:
+        k = jnp.exp(-_tile_d2(qk, xk))
+    else:
+        k = _matern_tile(_tile_d2(qc, xc))
+        if qk is not None:
+            k = k * jnp.exp(-_tile_d2(qk, xk))
+    noise, y_mean, y_std = params[0, 0], params[0, 1], params[0, 2]
+    best_y, beta = params[0, 3], params[0, 4]
+    mu = (k @ alpha).reshape(ROWS, LANES) * y_std + y_mean
+    if kinv is None:            # 'mean': no variance needed
+        return -mu
+    w = jnp.dot(k, kinv, preferred_element_type=jnp.float32)
+    q = (w * k).sum(axis=1).reshape(ROWS, LANES)
+    sd = jnp.sqrt(jnp.maximum(1.0 + noise - q, 1e-9)) * y_std
+    return _TRANSFORM[kind](mu, sd, best_y, beta)
+
+
+def _local_topk(u, gidx, k_sel: int, kpad: int):
+    """Streaming in-tile selection: `k_sel` rounds of (max value,
+    lowest-flat-index tie-break, mask) over the (ROWS, LANES) utility
+    block — the exact `lax.top_k` order.  Returns ((1, kpad) values
+    desc, (1, kpad) global indices); unfilled lanes hold (-inf, 2^30)
+    and can only surface when fewer than k finite candidates exist."""
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, kpad), 1)
+    big = jnp.int32(1 << 30)
+    neg = jnp.float32(-jnp.inf)
+
+    def body(j, carry):
+        vals, idxs, uu = carry
+        m = jnp.max(uu)
+        sel = jnp.min(jnp.where(uu == m, gidx, big))
+        vals = jnp.where(col == j, m, vals)
+        idxs = jnp.where(col == j, sel, idxs)
+        return vals, idxs, jnp.where(gidx == sel, neg, uu)
+
+    vals0 = jnp.full((1, kpad), neg, jnp.float32)
+    idxs0 = jnp.full((1, kpad), big, jnp.int32)
+    vals, idxs, _ = jax.lax.fori_loop(
+        0, k_sel, body, (vals0, idxs0, u))
+    return vals, idxs
+
+
+def _unpack(refs, kind: str, has_cont: bool, has_cat: bool):
+    """Positional ref unpack shared by both kernel bodies (the spec
+    list is built with the same flags in `_call_specs`)."""
+    it = iter(refs)
+    qc = next(it)[:] if has_cont else None
+    qk = next(it)[:] if has_cat else None
+    xc = next(it)[:] if has_cont else None
+    xk = next(it)[:] if has_cat else None
+    alpha = next(it)[:]
+    kinv = next(it)[:] if kind != "mean" else None
+    params = next(it)     # ref, read scalar-wise in _utility_tile
+    return qc, qk, xc, xk, alpha, kinv, params, list(it)
+
+
+def _scores_kernel(*refs, kind: str, has_cont: bool, has_cat: bool):
+    qc, qk, xc, xk, alpha, kinv, params, (out_ref,) = _unpack(
+        refs, kind, has_cont, has_cat)
+    out_ref[:] = _utility_tile(qc, qk, xc, xk, alpha, kinv, params, kind)
+
+
+def _topk_kernel(*refs, kind: str, has_cont: bool, has_cat: bool,
+                 k_sel: int, kpad: int, b_real: int):
+    from jax.experimental import pallas as pl
+    qc, qk, xc, xk, alpha, kinv, params, (vals_ref, idx_ref) = _unpack(
+        refs, kind, has_cont, has_cat)
+    u = _utility_tile(qc, qk, xc, xk, alpha, kinv, params, kind)
+    # global flat candidate index of each block element (row-major,
+    # matching the scores variant's reshape(B)); padded tail rows are
+    # masked out of the selection entirely
+    r = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (ROWS, LANES), 1)
+    gidx = pl.program_id(0) * (ROWS * LANES) + r * LANES + c
+    u = jnp.where(gidx < b_real, u, jnp.float32(-jnp.inf))
+    vals, idxs = _local_topk(u, gidx, k_sel, kpad)
+    vals_ref[:] = jnp.broadcast_to(vals, (ROWS, kpad))
+    idx_ref[:] = jnp.broadcast_to(idxs, (ROWS, kpad))
+
+
+# ------------------------------------------------------- pallas calls
+def _pl_setup():
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        vmem, smem = pltpu.VMEM, pltpu.SMEM
+    except ImportError:  # pragma: no cover
+        vmem = smem = None
+
+    def spec(shape, index_map=None, space=None):
+        kw = ({"memory_space": space or vmem}
+              if vmem is not None else {})
+        return pl.BlockSpec(shape, index_map, **kw)
+
+    return pl, spec, smem
+
+
+def _specs(spec, smem, qc, qk, xc, xk, alpha, kinv, params):
+    """(in_specs, args) for one fused call, in `_unpack` order: query
+    tiles stream by grid step; train blocks, alpha and K^-1 stay VMEM-
+    resident across the grid; the scalar pack rides SMEM."""
+    n = alpha.shape[0]
+    in_specs, args = [], []
+    if qc is not None:
+        in_specs.append(spec((TILE, qc.shape[1]), lambda i: (i, 0)))
+        args.append(qc)
+    if qk is not None:
+        in_specs.append(spec((TILE, qk.shape[1]), lambda i: (i, 0)))
+        args.append(qk)
+    if xc is not None:
+        in_specs.append(spec((n, xc.shape[1]), lambda i: (0, 0)))
+        args.append(xc)
+    if xk is not None:
+        in_specs.append(spec((n, xk.shape[1]), lambda i: (0, 0)))
+        args.append(xk)
+    in_specs.append(spec((n,), lambda i: (0,)))
+    args.append(alpha)
+    if kinv is not None:
+        in_specs.append(spec((n, n), lambda i: (0, 0)))
+        args.append(kinv)
+    in_specs.append(spec((1, 8), lambda i: (0, 0), space=smem))
+    args.append(params)
+    return in_specs, args
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "interpret"))
+def _scores_padded(qc, qk, xc, xk, alpha, kinv, params,
+                   kind: str, interpret: bool):
+    """Kernel route: [Bpad] utilities (Bpad a TILE multiple)."""
+    pl, spec, smem = _pl_setup()
+    b = (qc if qc is not None else qk).shape[0]
+    in_specs, args = _specs(spec, smem, qc, qk, xc, xk, alpha, kinv,
+                            params)
+    kernel = functools.partial(
+        _scores_kernel, kind=kind,
+        has_cont=qc is not None, has_cat=qk is not None)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b // LANES, LANES), jnp.float32),
+        grid=(b // TILE,),
+        in_specs=in_specs,
+        out_specs=spec((ROWS, LANES), lambda i: (i, 0)),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(b)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("kind", "k", "b_real", "interpret"))
+def _topk_padded(qc, qk, xc, xk, alpha, kinv, params,
+                 kind: str, k: int, b_real: int, interpret: bool):
+    """Kernel route: per-tile streaming top-k + one [n_tiles * kpad]
+    merge -> (values [k] desc, flat indices [k] i32)."""
+    pl, spec, smem = _pl_setup()
+    b = (qc if qc is not None else qk).shape[0]
+    nt = b // TILE
+    k_sel = min(k, TILE)
+    kpad = -(-k_sel // KLANES) * KLANES
+    in_specs, args = _specs(spec, smem, qc, qk, xc, xk, alpha, kinv,
+                            params)
+    kernel = functools.partial(
+        _topk_kernel, kind=kind,
+        has_cont=qc is not None, has_cat=qk is not None,
+        k_sel=k_sel, kpad=kpad, b_real=b_real)
+    ospec = spec((ROWS, kpad), lambda i: (i, 0))
+    vals, idxs = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((nt * ROWS, kpad), jnp.float32),
+                   jax.ShapeDtypeStruct((nt * ROWS, kpad), jnp.int32)),
+        grid=(nt,),
+        in_specs=in_specs,
+        out_specs=(ospec, ospec),
+        interpret=interpret,
+    )(*args)
+    # row 0 of each block carries the tile's winners; tiles concatenate
+    # in candidate-index order, so the merge's positional tie-break
+    # reproduces lax.top_k's global lowest-index tie-break
+    tv = vals.reshape(nt, ROWS, kpad)[:, 0, :].reshape(nt * kpad)
+    ti = idxs.reshape(nt, ROWS, kpad)[:, 0, :].reshape(nt * kpad)
+    mv, mp = jax.lax.top_k(tv, k)
+    return mv, jnp.minimum(ti[mp], jnp.int32(b_real - 1))
+
+
+# ------------------------------------------------------- XLA fallback
+def _utilities_xla(qc, qk, xc, xk, alpha, kinv, params, kind: str):
+    """[Bpad] utilities as ONE XLA program: the SAME tile function the
+    kernel runs, under lax.map over the SAME [TILE, ...] tiles — per-
+    tile intermediates only (no [B, N] in flight), and bitwise-equal
+    per-row results by construction."""
+    b = (qc if qc is not None else qk).shape[0]
+    nt = b // TILE
+
+    def tiles(a):
+        return None if a is None else a.reshape(nt, TILE, a.shape[1])
+
+    def body(t):
+        tqc, tqk = t
+        return _utility_tile(tqc, tqk, xc, xk, alpha, kinv, params,
+                             kind).reshape(TILE)
+
+    return jax.lax.map(body, (tiles(qc), tiles(qk))).reshape(b)
+
+
+@functools.partial(jax.jit, static_argnames=("kind",))
+def _scores_xla(qc, qk, xc, xk, alpha, kinv, params, kind: str):
+    return _utilities_xla(qc, qk, xc, xk, alpha, kinv, params, kind)
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "k", "b_real"))
+def _topk_xla(qc, qk, xc, xk, alpha, kinv, params,
+              kind: str, k: int, b_real: int):
+    u = _utilities_xla(qc, qk, xc, xk, alpha, kinv, params, kind)
+    gidx = jnp.arange(u.shape[0], dtype=jnp.int32)
+    u = jnp.where(gidx < b_real, u, jnp.float32(-jnp.inf))
+    mv, mp = jax.lax.top_k(u, k)
+    return mv, jnp.minimum(mp.astype(jnp.int32), jnp.int32(b_real - 1))
+
+
+# -------------------------------------------------- unfused reference
+@functools.partial(jax.jit, static_argnames=("kind",))
+def _scores_unfused(qc, qk, xc, xk, alpha, kinv, params, kind: str):
+    """The PRE-fusion pipeline staging, kept as the parity/bench
+    comparator: materialize the full [B, N] cross-kernel and the [B]
+    moment vectors — exactly the HBM intermediates the fused program
+    deletes — then apply the acquisition transform.  Same math as
+    `_utility_tile`; un-tiled staging (XLA may fuse differently, so
+    'mean' is bitwise-equal to the fused routes while 'ei'/'lcb' agree
+    to float32 fusion noise — the parity tests pin both)."""
+    if qc is None:
+        k = jnp.exp(-_tile_d2(qk, xk))
+    else:
+        k = _matern_tile(_tile_d2(qc, xc))
+        if qk is not None:
+            k = k * jnp.exp(-_tile_d2(qk, xk))
+    noise, y_mean, y_std = params[0, 0], params[0, 1], params[0, 2]
+    best_y, beta = params[0, 3], params[0, 4]
+    mu = (k @ alpha) * y_std + y_mean
+    if kinv is None:            # 'mean'
+        return -mu
+    w = jnp.dot(k, kinv, preferred_element_type=jnp.float32)
+    q = (w * k).sum(axis=1)
+    sd = jnp.sqrt(jnp.maximum(1.0 + noise - q, 1e-9)) * y_std
+    return _TRANSFORM[kind](mu, sd, best_y, beta)
+
+
+def acquire_scores_ref(state, xq: jax.Array, kind: str = "mean",
+                       best_y=None, beta: float = 2.0,
+                       n_cont: Optional[int] = None,
+                       n_cat: int = 0) -> jax.Array:
+    """Unfused-reference utilities (materialized intermediates) — the
+    A/B baseline `bench.py --multi` measures the fused pipeline
+    against, and the parity anchor for the tier-1 tests."""
+    _check(kind, best_y)
+    b = xq.shape[0]
+    args = _prep(state, xq, kind, best_y, beta, n_cont, n_cat)
+    return _scores_unfused(*args, kind=kind)[:b]
+
+
+def acquire_topk_ref(state, xq: jax.Array, k: int, kind: str = "mean",
+                     best_y=None, beta: float = 2.0,
+                     n_cont: Optional[int] = None, n_cat: int = 0
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Unfused-reference top-k: full scores vector, then `lax.top_k`."""
+    u = acquire_scores_ref(state, xq, kind, best_y, beta, n_cont, n_cat)
+    mv, mp = jax.lax.top_k(u, k)
+    return mv, mp.astype(jnp.int32)
+
+
+# ------------------------------------------------------ routed entries
+def _prep(state, xq, kind: str, best_y, beta: float,
+          n_cont: Optional[int], n_cat: int):
+    """Pre-scaled feature blocks + scalar pack, exactly the
+    `pallas_score.gp_mean_var_scores` conventions (cont block / ls, cat
+    one-hot block * sqrt(1/(n_cat*ls_cat)), alpha premasked, premasked
+    K^-1 preferred from the state)."""
+    b, f = xq.shape
+    pad = (-b) % TILE
+    xq32 = jnp.asarray(xq, jnp.float32)
+    if pad:
+        xq32 = jnp.concatenate(
+            [xq32, jnp.zeros((pad, f), jnp.float32)])
+    x32 = jnp.asarray(state.x, jnp.float32)
+    alpha = jnp.asarray(state.alpha, jnp.float32) * state.mask
+    kinv = None
+    if kind != "mean":
+        if state.kinv is not None:
+            kinv = jnp.asarray(state.kinv, jnp.float32)
+        else:
+            from ..surrogate import gp as _gp
+            kinv = jnp.asarray(_gp.precompute_kinv(state).kinv,
+                               jnp.float32)
+    mixed = n_cont is not None and n_cat and n_cont < f
+    if mixed:
+        cat_s = jnp.sqrt(1.0 / (float(n_cat) * state.ls_cat))
+        if n_cont == 0:
+            qc = xc = None
+            qk, xk = xq32 * cat_s, x32 * cat_s
+        else:
+            qc = xq32[:, :n_cont] / state.lengthscale
+            qk = xq32[:, n_cont:] * cat_s
+            xc = x32[:, :n_cont] / state.lengthscale
+            xk = x32[:, n_cont:] * cat_s
+    else:
+        qc, xc = xq32 / state.lengthscale, x32 / state.lengthscale
+        qk = xk = None
+    z = jnp.float32(0.0)
+    params = jnp.stack([
+        jnp.asarray(state.noise, jnp.float32),
+        jnp.asarray(state.y_mean, jnp.float32),
+        jnp.asarray(state.y_std, jnp.float32),
+        z if best_y is None else jnp.asarray(best_y, jnp.float32),
+        jnp.float32(beta), z, z, z]).reshape(1, 8)
+    return qc, qk, xc, xk, alpha, kinv, params
+
+
+def _check(kind: str, best_y):
+    if kind not in KINDS:
+        raise ValueError(f"unknown kind {kind!r}")
+    if kind == "ei" and best_y is None:
+        raise ValueError("kind='ei' needs best_y")
+
+
+def acquire_scores(state, xq: jax.Array, kind: str = "mean",
+                   best_y=None, beta: float = 2.0,
+                   n_cont: Optional[int] = None, n_cat: int = 0,
+                   route: Optional[str] = None) -> jax.Array:
+    """Fused acquisition UTILITIES (higher = better) for a [B, F] query
+    batch against a fitted GPState: -mean ('mean'), EI ('ei', vs
+    `best_y`), or -(mu - beta*sd) ('lcb') — scoring, moments, and the
+    acquisition transform in one device program (kernel or XLA
+    fallback per `ops/routing.py`; pass `route` to pin one)."""
+    _check(kind, best_y)
+    b = xq.shape[0]
+    if route is None:
+        route = routing.decide(b, min_rows=MIN_ROWS, cpu_ok=False)
+    args = _prep(state, xq, kind, best_y, beta, n_cont, n_cat)
+    if route == routing.XLA:
+        return _scores_xla(*args, kind=kind)[:b]
+    return _scores_padded(*args, kind=kind,
+                          interpret=routing.interpret_flag(route))[:b]
+
+
+def acquire_topk(state, xq: jax.Array, k: int, kind: str = "mean",
+                 best_y=None, beta: float = 2.0,
+                 n_cont: Optional[int] = None, n_cat: int = 0,
+                 route: Optional[str] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Fused score + acquisition + top-k: (utilities [k] descending,
+    flat candidate indices [k] i32), `lax.top_k` tie semantics (lowest
+    index wins).  The kernel route streams the selection per tile and
+    never writes the [B] utility vector to HBM."""
+    _check(kind, best_y)
+    b = int(xq.shape[0])
+    if not 1 <= k <= b:
+        raise ValueError(f"k must be in [1, {b}]: {k}")
+    if route is None:
+        route = routing.decide(b, min_rows=MIN_ROWS, cpu_ok=False)
+    args = _prep(state, xq, kind, best_y, beta, n_cont, n_cat)
+    if route == routing.XLA:
+        return _topk_xla(*args, kind=kind, k=k, b_real=b)
+    return _topk_padded(*args, kind=kind, k=k, b_real=b,
+                        interpret=routing.interpret_flag(route))
+
+
+def kernel_schema(n_train: int, n_feat: int, kind: str = "ei",
+                  k: int = 0) -> dict:
+    """Static tile/VMEM facts for one fused call shape — the roofline
+    protocol fields bench.py records (docs/PERF.md): tile dims and the
+    per-grid-step VMEM residency in bytes."""
+    kpad = -(-min(max(k, 1), TILE) // KLANES) * KLANES
+    vmem = 4 * (TILE * n_feat + n_train * n_feat + n_train + 8
+                + 2 * TILE * kpad)
+    if kind != "mean":
+        vmem += 4 * (n_train * n_train + 2 * TILE * n_train)
+    return {"tile_rows": TILE, "lanes": LANES, "sublanes": ROWS,
+            "k_lanes": (kpad if k else 0), "n_train": n_train,
+            "n_feat": n_feat, "vmem_bytes": vmem,
+            "min_rows_auto": MIN_ROWS}

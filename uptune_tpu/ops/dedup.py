@@ -183,7 +183,8 @@ def merge_rows_pallas(hist: _Rows, new: _Rows, pos_new: jax.Array,
     output positions `pos_new` ([b] i32, strictly increasing).  Output
     truncates at cap, exactly like merge_rows_xla."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from . import routing as _routing
+        interpret = _routing.interpret_default()
     cap = hist[0].shape[0]
     b = new[0].shape[0]
     if not pallas_merge_supported(cap, b):
@@ -232,14 +233,26 @@ def merge_rows_xla(hist: _Rows, new: _Rows,
 def merge_history(hist: _Rows, new: _Rows, impl: str = "auto") -> _Rows:
     """Route one history merge: `new` must be h0-sorted (old rows come
     before new rows on equal h0 — the History invariant).  impl:
-    'pallas' | 'xla' | 'auto' (pallas on TPU when the shapes qualify,
-    xla otherwise — the parity-tested fallback)."""
+    'pallas' | 'xla' | 'auto'.  'auto' routes through the shared
+    UT_PALLAS knob (`ops/routing.py`): the compiled kernel on TPU when
+    the shapes qualify, the XLA fallback otherwise (this site opts OUT
+    of the auto CPU-interpret route — the fallback is faster there —
+    but UT_PALLAS=interpret still forces the kernel for parity runs)."""
     pos_new = (jnp.arange(new[0].shape[0], dtype=jnp.int32)
                + jnp.searchsorted(hist[0], new[0], side="right"
                                   ).astype(jnp.int32))
-    if impl == "pallas" or (
-            impl == "auto" and jax.default_backend() == "tpu"
-            and pallas_merge_supported(hist[0].shape[0],
-                                       new[0].shape[0])):
+    from . import routing as _routing
+    if impl == "pallas":
         return merge_rows_pallas(hist, new, pos_new)
+    route = _routing.XLA
+    if impl == "auto":
+        route = _routing.decide(
+            new[0].shape[0], min_rows=0,
+            supported=pallas_merge_supported(hist[0].shape[0],
+                                             new[0].shape[0]),
+            cpu_ok=False)
+    if route != _routing.XLA:
+        return merge_rows_pallas(
+            hist, new, pos_new,
+            interpret=_routing.interpret_flag(route))
     return merge_rows_xla(hist, new, pos_new)

@@ -1,0 +1,101 @@
+"""Shared Pallas-kernel routing: one documented decision function for
+every kernel/XLA fork in the repo.
+
+Before this module each Pallas surface carried its own ad-hoc gate:
+`ops/dedup.py` forked on ``backend == "tpu" and shapes qualify``,
+`surrogate/pallas_score.py` + `gp.score_flat` on the bare
+``PALLAS_MIN_POOL`` constant with an ``interpret = backend != "tpu"``
+default, and the new fused acquisition kernel (`ops/acquire.py`) would
+have added a third copy.  They all route here now, under one
+user-facing knob:
+
+    UT_PALLAS=off | interpret | auto     (env, highest precedence)
+    ut.config(pallas='off'|'interpret'|'auto')
+    default: auto
+
+* ``auto``      — the production policy: the compiled Pallas kernel on
+  TPU when the call site's shapes qualify, the interpret-mode kernel on
+  CPU past each site's min-rows threshold (where the site opts in —
+  the `gp.score_flat` scoring kernels do, so the CPU mesh exercises
+  kernel math; the dedup merge and the fused acquisition pipeline do
+  not, their XLA fallbacks measure faster there), and the plain-XLA
+  fallback otherwise.
+* ``interpret`` — force the kernel route in interpret mode everywhere
+  the shapes are SUPPORTED, regardless of backend or batch size: the
+  debugging/CI setting that makes every kernel's math observable and
+  bitwise-comparable on any host.
+* ``off``       — force the XLA fallback everywhere: the bisection
+  setting (is a regression in the kernel or around it?).
+
+The decision runs at TRACE time (python, static shapes) — no
+jit-reachable host reads.
+"""
+from __future__ import annotations
+
+import os
+
+MODES = ("off", "interpret", "auto")
+
+# route verdicts
+PALLAS = "pallas"        # compiled kernel (TPU)
+INTERPRET = "interpret"  # kernel in pallas interpret mode (any host)
+XLA = "xla"              # plain-XLA fallback
+
+
+def pallas_mode(env: dict = None) -> str:
+    """The session's routing mode: ``UT_PALLAS`` env var >
+    ``ut.config('pallas')`` > ``'auto'``.  Unknown values raise — a
+    typo'd UT_PALLAS silently falling back to auto would unforce the
+    route mid-debug."""
+    e = os.environ if env is None else env
+    val = (e.get("UT_PALLAS") or "").strip().lower()
+    if not val:
+        from ..api import session as _session
+        val = (_session.settings.get("pallas") or "auto")
+        val = str(val).strip().lower()
+    if val not in MODES:
+        raise ValueError(
+            f"UT_PALLAS/config('pallas') must be one of {MODES}: {val!r}")
+    return val
+
+
+def decide(n_rows: int, min_rows: int = 0, supported: bool = True,
+           cpu_ok: bool = True, mode: str = None) -> str:
+    """Route one kernel call site: 'pallas' | 'interpret' | 'xla'.
+
+    `n_rows`/`min_rows` express the site's size gate (dedup's merge has
+    none — it passes min_rows=0); `supported` is the site's static
+    shape-qualification predicate; `cpu_ok` says whether the site wants
+    the interpret-mode kernel on non-TPU hosts in auto mode (the
+    `gp.score_flat` scoring kernels do; the dedup merge and the fused
+    acquisition pipeline do not — their XLA fallbacks are faster on
+    CPU).
+    `mode` overrides `pallas_mode()` for explicit-impl call sites."""
+    mode = pallas_mode() if mode is None else mode
+    if not supported or mode == "off":
+        return XLA
+    if mode == "interpret":
+        return INTERPRET
+    import jax
+    if jax.default_backend() == "tpu":
+        return PALLAS if n_rows >= min_rows else XLA
+    return INTERPRET if (cpu_ok and n_rows >= min_rows) else XLA
+
+
+def interpret_default() -> bool:
+    """The `interpret=None` resolution for kernel entries a caller
+    reaches DIRECTLY (the route fork already happened upstream, or the
+    caller forced the kernel explicitly): forced-interpret mode wins;
+    otherwise interpret off-TPU — the historical per-kernel default,
+    now honored in one place."""
+    if pallas_mode() == "interpret":
+        return True
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def interpret_flag(route: str) -> bool:
+    """The `interpret=` argument a pallas_call should receive for a
+    kernel-route verdict (PALLAS or INTERPRET)."""
+    assert route in (PALLAS, INTERPRET), route
+    return route == INTERPRET

@@ -465,9 +465,18 @@ def score_flat(state: GPState, xq: jax.Array, kind: str = "mean",
     lead = xq.shape[:-1]
     flat = xq.reshape((-1, xq.shape[-1]))
     from . import pallas_score  # local: pallas_score imports gp lazily
+    from ..ops import routing as _routing
     if pallas_min is None:
         pallas_min = pallas_score.PALLAS_MIN_POOL
-    fused = flat.shape[0] >= pallas_min
+    # the historical bare `>= PALLAS_MIN_POOL` gate, now routed through
+    # the shared UT_PALLAS knob: 'off' forces the predict path at any
+    # size, 'interpret' forces the fused kernels (interpret mode) at
+    # any size, 'auto' keeps the size gate
+    route = _routing.decide(flat.shape[0], min_rows=pallas_min,
+                            cpu_ok=True)
+    fused = route != _routing.XLA
+    if fused and interpret is None:
+        interpret = _routing.interpret_flag(route)
     if kind == "mean":
         out = (pallas_score.gp_mean_scores(
                    state, flat, interpret, n_cont, n_cat) if fused

@@ -899,11 +899,24 @@ class SurrogateManager:
         sidx = self._screen_idx
         sw = self._screen_w
         # at PALLAS_MIN_POOL+ candidates the [pool, N] cross-kernel is
-        # the acquisition hot spot; the fused Pallas kernel scores it
-        # tile-by-tile without materializing it in HBM (r4 verdict
-        # next-step #2 — this is the live call site)
-        use_pallas = (kind == "gp" and pool >= pallas_score.PALLAS_MIN_POOL)
+        # the acquisition hot spot; the fused acquisition pipeline
+        # (ops/acquire.py) scores it, applies EI/LCB and selects the
+        # n_out winners tile-by-tile without materializing [pool, N]
+        # or even the [pool] score vector in HBM.  Routing (UT_PALLAS
+        # knob, ops/routing.py) is decided HERE at build time — pool
+        # is static — so the jitted pool_fn contains exactly one
+        # implementation; XLA-routed pools keep the legacy
+        # materialized scoring below, bit-identical to before.
+        # cpu_ok=False: auto keeps the legacy path on CPU (the
+        # interpret-mode emulation measures slower than it — the
+        # ops/acquire.py routing note); UT_PALLAS=interpret still
+        # forces the kernel route for parity drives.
+        from ..ops import acquire, routing
         from ..ops import perm as perm_ops
+        route = (routing.decide(pool,
+                                min_rows=pallas_score.PALLAS_MIN_POOL,
+                                cpu_ok=False)
+                 if kind == "gp" else routing.XLA)
 
         def pool_fn(state, key, best_u, best_perms, best_y, flip_p):
             kr, kn, ks, kp, km, kv, kw, kf1, kf2, kf3 = \
@@ -988,14 +1001,18 @@ class SurrogateManager:
                 space.surrogate_transform(space.features(cands)),
                 sidx, sw)
             if kind == "gp":
-                if use_pallas:
-                    mu, sd = pallas_score.gp_mean_var_scores(
-                        state, feats, n_cont=nc, n_cat=ncat)
-                    if score_ei:
-                        score = -gp_mod.ei_from_moments(mu, sd, best_y)
-                    else:
-                        score = mu - 2.0 * sd
-                elif score_ei:
+                if route != routing.XLA:
+                    # fused score+acquisition+top-k in one device
+                    # program; argsort(score) ascending == top-k of
+                    # the (negated) utility, ties both resolved to
+                    # the lowest candidate index
+                    _, idx = acquire.acquire_topk(
+                        state, feats, n_out,
+                        kind=("ei" if score_ei else "lcb"),
+                        best_y=best_y, beta=2.0,
+                        n_cont=nc, n_cat=ncat, route=route)
+                    return cands[idx]
+                if score_ei:
                     score = -gp_mod.expected_improvement(
                         state, feats, best_y, n_cont=nc, n_cat=ncat)
                 else:

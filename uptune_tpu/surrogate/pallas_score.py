@@ -289,7 +289,8 @@ def gp_mean_var_scores(state, xq: jax.Array,
     the K^-1 quadratic-form tiling).  `n_cont`/`n_cat` MUST match the
     fit, exactly as in gp_mean_scores."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ..ops import routing as _routing
+        interpret = _routing.interpret_default()
     B, F = xq.shape
     pad = (-B) % VTILE
     xq32 = jnp.asarray(xq, jnp.float32)
@@ -339,9 +340,11 @@ def gp_mean_scores(state, xq: jax.Array,
     `n_cont`/`n_cat` MUST match the fit (a mixed-kernel state scored
     without them would treat one-hot flag lanes as continuous
     coordinates and drop ls_cat).  `interpret` defaults to True off-TPU
-    (pallas CPU path) and False on TPU."""
+    (pallas CPU path) and False on TPU, via the shared routing knob
+    (`ops/routing.py` — UT_PALLAS=interpret forces True anywhere)."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from ..ops import routing as _routing
+        interpret = _routing.interpret_default()
     B, F = xq.shape
     pad = (-B) % TILE
     xq32 = jnp.asarray(xq, jnp.float32)
