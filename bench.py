@@ -2218,6 +2218,21 @@ def serve_main() -> None:
         t_drive = time.perf_counter() - t_drive0
         scrape = clients[0].metrics()
         stats = clients[0].stats()
+
+        # teardown dogfoods the batch frames: one close wave per
+        # 128-session chunk instead of one RTT per session
+        def close_all(ti):
+            c, hs = clients[ti], handles_per[ti]
+            for i in range(0, len(hs), 128):
+                c.batch([{"op": "close", "session": h.id}
+                         for h in hs[i:i + 128]])
+
+        ts = [threading.Thread(target=close_all, args=(ti,))
+              for ti in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
         for c in clients:
             c.close()
         srv.stop()
@@ -2285,6 +2300,137 @@ def serve_main() -> None:
         s.close()
     t_warm = time.perf_counter() - t0
     agg_warm = warm_asks / t_warm
+
+    # ---------------- batched wire plane A/B (ISSUE 20) ---------------
+    # The per-shard-ceiling claim: against ONE dedicated server in the
+    # sharded tier's per-shard shape (slots = batch width, the
+    # `ut route --slots` default — NOT the phase-1 mega-group, whose
+    # N-wide proposes would swamp the wire term), W matched-seed
+    # session sets drive identical epoch schedules twice over: one arm
+    # speaking the per-op protocol (one ask RTT + one tell_many RTT
+    # per session per cycle), the other riding multi-op frames
+    # (SessionClient.ask_many / tell_many — 2 RTTs per W-session
+    # wave).  Client-observed wall, interleaved best-of reps (the
+    # BENCH_OBS rule: this box's throughput swings with co-tenant
+    # load, so both arms must sample the same weather).  Like the
+    # phase-2 baselines this constructs a fresh group, so it runs
+    # OUTSIDE the strict guard; store stays off, so matched seeds
+    # make the parity check exact: frames may change nothing but the
+    # transport — each session's offered-config trajectory must be
+    # bitwise identical across arms.
+    ab_w = 8
+    ab_epochs = 2
+    ab_reps = 3 if quick else 5
+    ab_srv = SessionServer(port=0, slots=ab_w,
+                           max_sessions=4 * ab_w,
+                           store_dir="off").start()
+    abc = connect(("127.0.0.1", ab_srv.port))
+
+    def _ab_open(seed0):
+        return [abc.open_session(records, seed=seed0 + i,
+                                 program="bench-ab", store=False,
+                                 history_capacity=hist)
+                for i in range(ab_w)]
+
+    def _ab_seq(hs, traj):
+        """Per-op arm: the pre-frame wire shape."""
+        n = 0
+        t0 = time.perf_counter()
+        for _e in range(ab_epochs):
+            for i, h in enumerate(hs):
+                done = False
+                while not done:
+                    tr = h.ask(ask_n)
+                    if not tr:
+                        done = True
+                        continue
+                    n += len(tr)
+                    cfgs = [t.config for t in tr]
+                    traj[i].append(cfgs)
+                    qs = measure_all(cfgs)
+                    r = h.tell_many(zip((t.ticket for t in tr), qs))
+                    done = bool(r.get("committed"))
+        return n, time.perf_counter() - t0
+
+    def _ab_bat(hs, traj):
+        """Frame arm: one ask frame + one tell_many frame per wave
+        across every live session.  Measurement stays per-session
+        (identical cost to the per-op arm) so the ratio prices the
+        wire plane, not objective batching."""
+        n = 0
+        t0 = time.perf_counter()
+        idx = {id(h): i for i, h in enumerate(hs)}
+        for _e in range(ab_epochs):
+            live = list(hs)
+            while live:
+                offers = abc.ask_many(live, n=ask_n)
+                pairs, keep = [], []
+                for h, tr in zip(live, offers):
+                    if not tr:
+                        continue
+                    n += len(tr)
+                    cfgs = [t.config for t in tr]
+                    traj[idx[id(h)]].append(cfgs)
+                    qs = measure_all(cfgs)
+                    pairs.append(
+                        (h, list(zip((t.ticket for t in tr), qs))))
+                    keep.append(h)
+                if not pairs:
+                    break
+                replies = abc.tell_many(pairs)
+                live = [h for h, r in zip(keep, replies)
+                        if not r.get("committed")]
+        return n, time.perf_counter() - t0
+
+    try:
+        # warmup pair outside timing: group construction + compile
+        # land on the first open; both arms then run warm
+        hs = _ab_open(318000)
+        _ab_seq(hs, [[] for _ in range(ab_w)])
+        for h in hs:
+            h.close()
+        seq_t, bat_t = [], []
+        asks_seq = asks_bat = 0
+        parity_ok = True
+        for rep in range(ab_reps):
+            s0 = 320000 + rep * 1000
+            tr_s = [[] for _ in range(ab_w)]
+            tr_b = [[] for _ in range(ab_w)]
+            for arm in ((0, 1) if rep % 2 == 0 else (1, 0)):
+                if arm == 0:
+                    hs = _ab_open(s0)
+                    n_, t = _ab_seq(hs, tr_s)
+                    seq_t.append(t)
+                    asks_seq = n_
+                else:
+                    hs = _ab_open(s0)
+                    n_, t = _ab_bat(hs, tr_b)
+                    bat_t.append(t)
+                    asks_bat = n_
+                for h in hs:
+                    h.close()
+            if json.dumps(tr_s) != json.dumps(tr_b):
+                parity_ok = False
+    finally:
+        abc.close()
+        ab_srv.stop()
+    assert asks_seq == asks_bat, (asks_seq, asks_bat)
+    ab_ratio = min(seq_t) / min(bat_t)
+    batched_wire = {
+        "batch_width": ab_w,
+        "slots": ab_w,
+        "epochs_per_arm": ab_epochs,
+        "reps": ab_reps,
+        "asks_per_arm": asks_seq,
+        "ratio_batched_over_sequential": round(ab_ratio, 2),
+        "bar": 2.0,
+        "bar_met": bool(ab_ratio >= 2.0),
+        "parity_ok": parity_ok,
+        "sequential_best_s": round(min(seq_t), 4),
+        "batched_best_s": round(min(bat_t), 4),
+        "sequential_agg_asks_per_s": round(asks_seq / min(seq_t), 1),
+        "batched_agg_asks_per_s": round(asks_bat / min(bat_t), 1),
+    }
 
     # ---------------- phase 3 (--quick): lock-sanitizer overhead ------
     # the shipping bar for leaving UT_LOCK_GUARD on in diagnostic runs:
@@ -2427,6 +2573,7 @@ def serve_main() -> None:
         "speedup_vs_cold_sequential": round(agg / agg_cold, 1),
         "speedup_vs_warm_sequential": round(agg / agg_warm, 2),
         "serve_time_to_first_trial_s": round(t_open / n_sessions, 4),
+        "batched_wire": batched_wire,
         "nproc": os.cpu_count(),
     }
     if guard.enabled:
@@ -2476,6 +2623,15 @@ def serve_main() -> None:
                              "on CPU are expected (both sides "
                              "throughput-bound; the instance axis "
                              "exists to fill a chip, BENCH_MULTI)",
+            "batched_wire": "dedicated server in the per-shard shape "
+                            "(slots = batch width, the ut route "
+                            "--slots default), matched-seed "
+                            "8-session arms, identical epoch "
+                            "schedules: per-op requests vs multi-op "
+                            "frames (ask_many/tell_many — 2 RTTs "
+                            "per wave); interleaved best-of reps; "
+                            "parity = per-session offered-config "
+                            "trajectories bitwise equal across arms",
         },
     }
     name = "BENCH_SERVE.quick.json" if quick else "BENCH_SERVE.json"
@@ -2491,6 +2647,20 @@ def serve_main() -> None:
               f"(ratio {lock_overhead['guarded_over_unguarded']} vs "
               f"bar {lock_overhead['bar']}, "
               f"cycles {lock_overhead['cycles']})", file=sys.stderr)
+        sys.exit(1)
+    if not batched_wire["parity_ok"]:
+        # determinism, not weather: matched-seed arms diverging means
+        # the frames changed semantics, not just transport — gated in
+        # quick AND full runs
+        print("bench --serve: batched-wire PARITY FAILED (matched-seed"
+              " frame arm diverged from the per-op arm)",
+              file=sys.stderr)
+        sys.exit(1)
+    if not quick and not batched_wire["bar_met"]:
+        print("bench --serve: batched-wire gate FAILED (ratio "
+              f"{batched_wire['ratio_batched_over_sequential']} vs "
+              f"bar {batched_wire['bar']} at width "
+              f"{batched_wire['batch_width']})", file=sys.stderr)
         sys.exit(1)
 
 

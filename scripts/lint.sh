@@ -64,9 +64,16 @@ fi
 # loop whose handlers run on a bounded worker pool, so a lock held
 # across a blocking call now stalls the whole connection plane, not
 # one thread — since ISSUE 15 serve/durable.py is the write-ahead
-# checkpoint plane the zero-committed-loss contract rests on, and
+# checkpoint plane the zero-committed-loss contract rests on,
 # since ISSUE 17 serve/router.py is the sharded front tier whose
-# supervisor thread + session map sit in front of every shard) get
+# supervisor thread + session map sit in front of every shard, and
+# since ISSUE 20 the batched wire plane threads the whole package:
+# serve/wire.py owns the batch-frame dispatch + WireReply encode
+# fast path every reply rides, serve/session.py applies whole
+# tell_many batches inside ONE group-lock hold (a hazard there now
+# stalls k tells, not one), and serve/server.py + serve/client.py
+# splice preserialized reply fragments whose text/dict equivalence
+# is a correctness contract) get
 # no '# ut-lint: disable' escape hatch and no baseline
 "${PYTHON:-python3}" - <<'EOF'
 import json, subprocess, sys

@@ -339,6 +339,83 @@ class TestAckBeforeDurable:
         assert active(src, "R104") == []
         assert len(suppressed(src, "R104")) == 1
 
+    # -- split-phase `*_locked` appliers (ISSUE 20) -------------------
+    # A `*_locked` method that commits is the under-lock half of a
+    # split-phase tell: exempt itself, but calls to it ARE commits, so
+    # the drain obligation lands on every caller.
+
+    def test_negative_locked_half_callers_drain(self):
+        # the tell/tell_many shape: one drain per batch, after the
+        # locked applier, before the reply
+        fs = active("""
+            from uptune_tpu.serve import durable
+
+            class S:
+                def _drain_ckpt(self):
+                    pass
+
+                def _tell_locked(self, ticket, qor):
+                    self._commit()
+                    return {"committed": True}
+
+                def tell(self, ticket, qor):
+                    with self.group.lock:
+                        res = self._tell_locked(ticket, qor)
+                    self._drain_ckpt()
+                    return res
+
+                def tell_many(self, rows):
+                    out = []
+                    with self.group.lock:
+                        for t, q in rows:
+                            out.append(self._tell_locked(t, q))
+                    self._drain_ckpt()
+                    return out
+        """, "R104")
+        assert fs == []
+
+    def test_positive_locked_half_caller_skips_drain(self):
+        # a caller that acks without draining is flagged AT THE CALL
+        # SITE — the hazard the per-function scan alone cannot see
+        src = """
+            from uptune_tpu.serve import durable
+
+            class S:
+                def _drain_ckpt(self):
+                    pass
+
+                def _tell_locked(self, ticket, qor):
+                    self._commit()
+                    return {"committed": True}
+
+                def tell(self, ticket, qor):
+                    with self.group.lock:
+                        res = self._tell_locked(ticket, qor)
+                    return res
+        """
+        fs = active(src, "R104")
+        assert len(fs) == 1 and fs[0].line == 13
+
+    def test_positive_locked_suffix_without_commit_not_exempt(self):
+        # the suffix alone is not a pass: a non-committing `*_locked`
+        # helper is no carrier, and a plain method that commits and
+        # acks still fires even if a `*_locked` name exists nearby
+        fs = active("""
+            from uptune_tpu.serve import durable
+
+            class S:
+                def _drain_ckpt(self):
+                    pass
+
+                def _peek_locked(self):
+                    return self.version
+
+                def op_tell(self, st):
+                    self.state._commit()
+                    return {"committed": True}
+        """, "R104")
+        assert len(fs) == 1 and fs[0].line == 11
+
 
 # ---------------------------------------------------------------- R105
 class TestThreadWithoutJoin:

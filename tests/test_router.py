@@ -181,6 +181,52 @@ class TestRouterOps:
         out = router.handle({"op": "route"})
         assert not out["ok"] and "key" in out["error"]
 
+    def test_resolve_multi_signature(self, router):
+        """ISSUE 20: many spaces (or precomputed keys) map to their
+        owning shards in ONE round trip, with element-wise error
+        rows — one malformed entry never discards its siblings."""
+        out = router.handle({"op": "resolve",
+                             "spaces": [_recs(0), _recs(1),
+                                        "bad", []]})
+        assert out["ok"]
+        rows = out["resolved"]
+        assert len(rows) == 4
+        want = router.handle({"op": "route", "space": _recs(0)})
+        assert rows[0]["shard"] == want["shard"]
+        assert rows[0]["addr"] == want["addr"]
+        assert rows[0]["key"] == routing_key(_recs(0))[:12]
+        assert "error" in rows[2] and "error" in rows[3]
+        # the keys form agrees with the spaces form
+        byk = router.handle({"op": "resolve",
+                             "keys": [routing_key(_recs(1))]})
+        assert byk["ok"]
+        assert byk["resolved"][0]["shard"] == rows[1]["shard"]
+
+    def test_resolve_validation_and_cap(self, router):
+        for bad in ({}, {"spaces": "x"}, {"keys": 3}):
+            out = router.handle({"op": "resolve", **bad})
+            assert not out["ok"], bad
+        router.MAX_RESOLVE = 2
+        try:
+            out = router.handle({"op": "resolve",
+                                 "keys": ["a", "b", "c"]})
+            assert not out["ok"] and "capped" in out["error"]
+        finally:
+            del router.MAX_RESOLVE
+
+    def test_batch_frame_inherited_from_kernel(self, router):
+        """`ut route` speaks multi-op frames with no op-table change
+        (the ISSUE 20 kernel seam): ping + route + resolve in one
+        frame, ordered replies."""
+        out = router.handle({"op": "batch", "ops": [
+            {"op": "ping"},
+            {"op": "route", "space": _recs(0)},
+            {"op": "resolve", "keys": [routing_key(_recs(1))]}]})
+        assert out["ok"] and out["n"] == 3 and out["failed"] == 0
+        assert out["replies"][0]["role"] == "router"
+        assert out["replies"][1]["shard"]
+        assert out["replies"][2]["resolved"][0]["shard"]
+
     def test_shards_rows_sorted(self, router):
         out = router.handle({"op": "shards"})
         assert out["ok"] and out["target"] == 3

@@ -13,6 +13,11 @@ Coverage map:
   multiplexing contract; soak version with churn slow-marked)
 * cross-tenant memo: one tenant's recorded builds serve another's
   ask; program tokens scope the sharing
+* batched wire plane (ISSUE 20): cross-session ask_many/tell_many
+  frames bitwise equal to the per-op drive at matched seeds,
+  duplicate replay squashed through the vectorized tell_many op,
+  down-level server compat fallback (kernel-level frame semantics
+  live in test_wire_batch.py)
 * strict no-retrace: join/leave/ask/tell churn rides three compiled
   programs, each traced exactly once
 * `bench.py --serve --quick` tier-1 smoke
@@ -409,21 +414,22 @@ class TestDistributedObs:
         obs.disable()
         try:
             captured = {}
-            real = json.dumps
-
-            def spy(payload, **kw):
-                if isinstance(payload, dict) and "op" in payload:
-                    captured.setdefault(payload["op"], payload)
-                return real(payload, **kw)
 
             with connect(("127.0.0.1", server.port)) as c:
                 import uptune_tpu.serve.client as mod
-                old = mod.json.dumps
-                mod.json.dumps = spy
+                real = mod._ENC
+
+                def spy(payload):
+                    if isinstance(payload, dict) and "op" in payload:
+                        captured.setdefault(payload["op"], payload)
+                    return real(payload)
+
+                old = mod._ENC
+                mod._ENC = spy
                 try:
                     c.ping()
                 finally:
-                    mod.json.dumps = old
+                    mod._ENC = old
             assert "ctx" not in captured["ping"]
         finally:
             if was:
@@ -570,6 +576,166 @@ class TestCrossTenantMemo:
                 assert d.best()["store_served"] == 0
 
 
+class TestBatchedWirePlane:
+    """ISSUE 20 on the session server: cross-session frames and the
+    vectorized tell_many op.  Kernel-level frame semantics (error
+    entries, nesting, oversize, encode fast path) are nailed down in
+    test_wire_batch.py; here the engine-backed server proves the
+    frames change the transport and nothing else."""
+
+    SEEDS = (611, 622, 633)
+
+    def test_frame_drive_matches_sequential_offline(self, server,
+                                                    offline):
+        """Bitwise matched-seed parity: sessions driven through
+        cross-session frames (SessionClient.ask_many / tell_many —
+        2 RTTs per wave) yield offered-config trajectories and
+        incumbents equal to the per-op offline drive."""
+        with connect(("127.0.0.1", server.port)) as c:
+            hs = [c.open_session(_space(), seed=s, store=False)
+                  for s in self.SEEDS]
+            offered = {h.id: [] for h in hs}
+            target = {h.id: h.version + 2 for h in hs}
+            live = list(hs)
+            while live:
+                offers = c.ask_many(live, n=7)
+                pairs = []
+                for h, tr in zip(live, offers):
+                    if tr:
+                        offered[h.id].extend(t.config for t in tr)
+                        pairs.append(
+                            (h, [(t.ticket, _measure(t.config))
+                                 for t in tr]))
+                if pairs:
+                    c.tell_many(pairs)
+                live = [h for h in hs if h.version < target[h.id]]
+            bests = {h.id: h.best() for h in hs}
+            for h in hs:
+                h.close()
+        assert c._batch_ok is True       # frames actually rode
+        for h, seed in zip(hs, self.SEEDS):
+            s = offline.join(seed=seed)
+            try:
+                want = _drive_epochs(s, epochs=2)
+                wb = s.best()
+            finally:
+                s.close()
+            assert offered[h.id] == want, f"seed {seed} diverged"
+            assert bests[h.id]["qor"] == wb["qor"]
+            assert bests[h.id]["config"] == wb["config"]
+            assert bests[h.id]["version"] == 2
+
+    def test_tell_many_replay_squashes_duplicates(self, server):
+        """At-least-once retries through the vectorized op: replaying
+        an already-told batch (the ack was lost) squashes every row —
+        told=0, duplicates=n, no errors, version unchanged (PR 15's
+        epoch-tag matrix, through the ISSUE 20 op) — including when
+        the replay rides a batch frame, the client-resume shape."""
+        recs = records_from_space(_space())
+        r = server.handle({"op": "open", "space": recs,
+                           "store": "off", "seed": 71})
+        assert r["ok"], r
+        sid = r["session"]
+        try:
+            a = server.handle({"op": "ask", "session": sid, "n": 4})
+            rows = [{"ticket": t["ticket"],
+                     "qor": _measure(t["config"]),
+                     "epoch": t["epoch"]} for t in a["trials"]]
+            req = {"op": "tell_many", "session": sid,
+                   "results": rows, "incarn": a["incarn"]}
+            r1 = server.handle(req)
+            assert r1["ok"] and r1["told"] == len(rows)
+            assert r1["duplicates"] == 0 and "errors" not in r1
+            r2 = server.handle(dict(req))        # the replay
+            assert r2["ok"] and r2["told"] == 0
+            assert r2["duplicates"] == len(rows)
+            assert "errors" not in r2
+            assert r2["version"] == r1["version"]
+            fr = server.handle({"op": "batch", "ops": [dict(req)]})
+            assert fr["ok"] and fr["failed"] == 0
+            assert fr["replies"][0]["duplicates"] == len(rows)
+        finally:
+            server.handle({"op": "close", "session": sid})
+
+    def test_tell_many_bad_row_stays_element_wise(self, server):
+        """One malformed row in a tell_many batch becomes an `errors`
+        entry and leaves ITS ticket live for retry; the siblings
+        apply — nothing is stranded."""
+        recs = records_from_space(_space())
+        r = server.handle({"op": "open", "space": recs,
+                           "store": "off", "seed": 72})
+        sid = r["session"]
+        try:
+            a = server.handle({"op": "ask", "session": sid, "n": 3})
+            t0, t1, t2 = a["trials"]
+            out = server.handle({
+                "op": "tell_many", "session": sid, "incarn":
+                a["incarn"], "results": [
+                    {"ticket": t0["ticket"],
+                     "qor": _measure(t0["config"]),
+                     "epoch": t0["epoch"]},
+                    {"ticket": t1["ticket"], "qor": 1.0,
+                     "dur": "not-a-float",
+                     "epoch": t1["epoch"]},
+                    {"ticket": 10 ** 9, "qor": 1.0},
+                ]})
+            assert out["ok"] and out["told"] == 1
+            assert len(out["errors"]) == 2
+            assert out["errors"][1]["ticket"] == 10 ** 9
+            # the malformed row's ticket is still live: a clean
+            # retry applies it
+            ok2 = server.handle({
+                "op": "tell_many", "session": sid, "incarn":
+                a["incarn"], "results": [
+                    {"ticket": t1["ticket"],
+                     "qor": _measure(t1["config"]),
+                     "epoch": t1["epoch"]},
+                    {"ticket": t2["ticket"],
+                     "qor": _measure(t2["config"]),
+                     "epoch": t2["epoch"]}]})
+            assert ok2["told"] == 2 and "errors" not in ok2
+        finally:
+            server.handle({"op": "close", "session": sid})
+
+    def test_downlevel_server_compat_fallback(self, tmp_path):
+        """Against a server predating ISSUE 20 (no batch intercept,
+        no tell_many op) the client sniffs the unknown-op reply ONCE
+        and degrades: frames go sequential, handle.tell_many rides
+        the legacy tell+results spelling — same results, more RTTs."""
+        srv = SessionServer(host="127.0.0.1", port=0, slots=2,
+                            max_sessions=8, store_dir="off")
+        real = srv.handle
+
+        def old_handle(req):
+            op = req.get("op") if isinstance(req, dict) else None
+            if op in ("batch", "tell_many"):
+                return {"ok": False,
+                        "error": f"unknown op {op!r}; valid: [...]"}
+            return real(req)
+
+        srv.handle = old_handle
+        srv.start()
+        try:
+            with connect(("127.0.0.1", srv.port)) as c:
+                h = c.open_session(_space(), seed=81, store=False)
+                trials = c.ask_many([h], n=3)[0]
+                assert len(trials) == 3
+                assert c._batch_ok is False      # sniffed + degraded
+                r = h.tell_many([(t.ticket, _measure(t.config))
+                                 for t in trials])
+                assert r["told"] == 3
+                assert c._tell_many_ok is False
+                # the fallback path keeps working quietly
+                trials = c.ask_many([h], n=2)[0]
+                r = c.tell_many(
+                    [(h, [(t.ticket, _measure(t.config))
+                          for t in trials])])[0]
+                assert r["told"] == 2
+                h.close()
+        finally:
+            srv.stop()
+
+
 class TestNoRetrace:
     def test_join_leave_churn_traces_each_program_once(self):
         """Strict trace-guard over a FRESH group's whole lifetime:
@@ -623,5 +789,16 @@ class TestBenchSmoke:
         assert out["churn"]["opened"] > 0
         assert out["retraces"]["excess"] == {}
         assert out["baseline_cold_sequential"]["agg_asks_per_s"] > 0
+        # the batched wire plane A/B (ISSUE 20): schema only — the
+        # ratio is recorded, not gated, in --quick (the 2.0x bar is
+        # the full run's gate); parity is determinism, so it IS a
+        # hard assert here
+        bw = out["batched_wire"]
+        assert bw["batch_width"] == 8 and bw["bar"] == 2.0
+        assert bw["parity_ok"] is True
+        assert bw["asks_per_arm"] > 0
+        assert bw["ratio_batched_over_sequential"] > 0
+        assert bw["sequential_agg_asks_per_s"] > 0
+        assert bw["batched_agg_asks_per_s"] > 0
         assert os.path.exists(os.path.join(REPO,
                                            "BENCH_SERVE.quick.json"))

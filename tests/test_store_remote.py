@@ -163,6 +163,22 @@ class TestStoreServerOps:
         assert r["acked"] and not r["dup"]
         srv2.stop()
 
+    def test_batch_frame_inherited_from_kernel(self, tmp_path):
+        """`ut store` speaks multi-op frames with no op-table change
+        (the ISSUE 20 kernel seam): record + lookup in ONE frame,
+        ordered replies, failures element-wise."""
+        srv = StoreServer("127.0.0.1", 0, str(tmp_path))
+        out = srv.handle({"op": "batch", "ops": [
+            {"op": "record", "row": self._row(0, 2.5)},
+            {"op": "lookup", "k": "k0"},
+            {"op": "nope"}]})
+        assert out["ok"] and out["n"] == 3 and out["failed"] == 1
+        r = out["replies"]
+        assert r[0]["acked"] and not r[0]["dup"]
+        assert r[1]["row"]["qor"] == 2.5   # sees the sub-op before it
+        assert "unknown op" in r[2]["error"]
+        srv.stop()
+
     def test_health_and_metrics_shapes(self, tmp_path):
         srv = StoreServer("127.0.0.1", 0, str(tmp_path))
         h = srv.handle({"op": "health"})

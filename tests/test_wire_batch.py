@@ -1,0 +1,252 @@
+"""Batched wire plane (ISSUE 20): multi-op frames + the encode fast
+path, tested at the kernel seam (serve/wire.py) with a plain
+WireServer subclass — no engine, no jax, fast.
+
+What is nailed down here:
+
+* a ``{"op": "batch", "ops": [...]}`` frame dispatches every sub-op
+  through the SAME per-op error wall a lone request gets: malformed
+  sub-ops come back as error ENTRIES in the ordered reply list, never
+  a poisoned frame or connection;
+* frame-level validation (non-list / empty ops, the max_batch_ops
+  amplification cap, no nesting) fails the FRAME, cleanly;
+* the text/dict equivalence contract of WireReply: a preserialized
+  ``wire_text`` must decode to exactly the dict the in-process caller
+  sees, including after an ``id`` echo splice;
+* the ``_on_response`` hook fans out per sub-op (connection-scoped
+  ownership tracking must observe every sub-request, never the
+  opaque frame);
+* over TCP: one frame in, ONE coalesced reply line out, and the
+  ``max_line`` cap applies to the frame exactly as to a single
+  request (one clean oversize error, then close).
+"""
+import json
+import socket
+
+import pytest
+
+from uptune_tpu.serve.wire import (RequestError, WireReply, WireServer,
+                                   encode_reply, _set_id)
+
+
+class _EchoServer(WireServer):
+    WIRE_NAME = "ut-test-batch"
+
+    def _op_ping(self, req):
+        return {"t": 1}
+
+    def _op_echo(self, req):
+        return {"v": req.get("v")}
+
+    def _op_ctx(self, req):
+        return {"ctx_seen": req.get("ctx")}
+
+    def _op_bad(self, req):
+        raise RequestError("told you so")
+
+    def _op_boom(self, req):
+        raise RuntimeError("kaboom")
+
+    def _op_fast(self, req):
+        out = WireReply(ok=True, v=req.get("v"))
+        out.wire_text = '{"ok":true,"v":%s}' % json.dumps(req.get("v"))
+        return out
+
+    _OPS = {"ping": _op_ping, "echo": _op_echo, "ctx": _op_ctx,
+            "bad": _op_bad, "boom": _op_boom, "fast": _op_fast}
+
+
+class _HookServer(_EchoServer):
+    """Records every (op, ok) pair `_on_response` observes."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.seen = []
+
+    def _on_response(self, state, req, resp):
+        self.seen.append((req.get("op"), bool(resp.get("ok"))))
+
+
+@pytest.fixture()
+def srv():
+    return _EchoServer("127.0.0.1", 0)
+
+
+# ---------------------------------------------------------------------
+class TestBatchDispatch:
+    def test_ordered_replies_one_frame(self, srv):
+        out = srv.handle({"op": "batch", "ops": [
+            {"op": "echo", "v": 1}, {"op": "ping"},
+            {"op": "echo", "v": "x"}]})
+        assert out["ok"] is True
+        assert out["n"] == 3 and out["failed"] == 0
+        assert [r.get("v", r.get("t")) for r in out["replies"]] \
+            == [1, 1, "x"]
+        assert all(r["ok"] for r in out["replies"])
+
+    def test_partial_failure_stays_element_wise(self, srv):
+        """One bad sub-op = one error ENTRY; its siblings' results
+        survive in order — the frame itself stays ok=True."""
+        out = srv.handle({"op": "batch", "ops": [
+            {"op": "echo", "v": 1},
+            {"op": "nope"},                 # unknown op
+            "not a dict",                   # malformed sub-op
+            {"op": "bad"},                  # handler RequestError
+            {"op": "boom"},                 # handler crash -> wall
+            {"op": "echo", "v": 2}]})
+        assert out["ok"] is True
+        assert out["n"] == 6 and out["failed"] == 4
+        r = out["replies"]
+        assert r[0] == {"ok": True, "v": 1}
+        assert not r[1]["ok"] and "unknown op" in r[1]["error"]
+        assert not r[2]["ok"] and "JSON object" in r[2]["error"]
+        assert not r[3]["ok"] and r[3]["error"] == "told you so"
+        assert not r[4]["ok"] and r[4]["error"].startswith("internal:")
+        assert r[5] == {"ok": True, "v": 2}
+
+    def test_frames_do_not_nest(self, srv):
+        out = srv.handle({"op": "batch", "ops": [
+            {"op": "batch", "ops": [{"op": "ping"}]},
+            {"op": "ping"}]})
+        assert out["ok"] is True and out["failed"] == 1
+        assert "nest" in out["replies"][0]["error"]
+        assert out["replies"][1]["ok"]
+
+    def test_frame_level_validation(self, srv):
+        for ops in (None, [], "ping", {"op": "ping"}):
+            out = srv.handle({"op": "batch", "ops": ops})
+            assert out["ok"] is False
+            assert "non-empty list" in out["error"]
+
+    def test_amplification_cap(self, srv):
+        srv.max_batch_ops = 4
+        out = srv.handle(
+            {"op": "batch", "ops": [{"op": "ping"}] * 5})
+        assert out["ok"] is False and "caps frames at 4" in out["error"]
+        # at the cap is fine
+        out = srv.handle(
+            {"op": "batch", "ops": [{"op": "ping"}] * 4})
+        assert out["ok"] is True and out["n"] == 4
+
+    def test_frame_ctx_covers_bare_sub_ops(self, srv):
+        """The frame's trace context flows into sub-ops that carry
+        none of their own — and never overwrites one they do."""
+        out = srv.handle({"op": "batch", "ctx": {"span": "abc"},
+                          "ops": [{"op": "ctx"},
+                                  {"op": "ctx",
+                                   "ctx": {"span": "own"}}]})
+        assert out["replies"][0]["ctx_seen"] == {"span": "abc"}
+        assert out["replies"][1]["ctx_seen"] == {"span": "own"}
+
+    def test_id_echo_on_frame(self, srv):
+        out = srv.handle({"op": "batch", "id": 7,
+                          "ops": [{"op": "ping"}]})
+        assert out["id"] == 7
+        assert json.loads(encode_reply(out))["id"] == 7
+
+
+# ---------------------------------------------------------------------
+class TestEncodeFastPath:
+    def test_wire_reply_text_dict_equivalence(self, srv):
+        """THE contract: the preserialized text decodes to exactly
+        the dict an in-process caller sees."""
+        out = srv.handle({"op": "fast", "v": [1, "x", None]})
+        assert type(out) is WireReply
+        assert json.loads(out.wire_text) == dict(out)
+        assert encode_reply(out) is out.wire_text
+
+    def test_set_id_patches_text_and_dict(self):
+        r = WireReply(ok=True, v=1)
+        r.wire_text = '{"ok":true,"v":1}'
+        _set_id(r, "a-b")
+        assert r["id"] == "a-b"
+        assert json.loads(r.wire_text) == dict(r)
+
+    def test_plain_dict_uses_cached_encoder(self):
+        assert json.loads(encode_reply({"ok": True, "v": 2})) \
+            == {"ok": True, "v": 2}
+
+    def test_batch_frame_splices_sub_reply_texts(self, srv):
+        """The frame's own wire_text is the spliced sub-reply texts —
+        decode it and the dict view must agree, fast-path sub-ops
+        included."""
+        out = srv.handle({"op": "batch", "ops": [
+            {"op": "fast", "v": 3}, {"op": "nope"},
+            {"op": "echo", "v": {"k": [1.5]}}]})
+        assert type(out) is WireReply
+        assert json.loads(out.wire_text) == json.loads(
+            json.dumps(out))
+
+    def test_handler_wire_reply_survives_id_echo(self, srv):
+        out = srv.handle({"op": "fast", "v": 9, "id": 4})
+        assert out["id"] == 4 and out["v"] == 9
+        assert json.loads(out.wire_text) == dict(out)
+
+
+# ---------------------------------------------------------------------
+class TestHookFanOut:
+    def test_on_response_sees_sub_ops_not_the_frame(self):
+        s = _HookServer("127.0.0.1", 0)
+        s._dispatch(None, {"op": "batch", "ops": [
+            {"op": "ping"}, {"op": "nope"}, {"op": "echo", "v": 1}]})
+        assert s.seen == [("ping", True), ("nope", False),
+                          ("echo", True)]
+
+    def test_single_request_hook_unchanged(self):
+        s = _HookServer("127.0.0.1", 0)
+        s._dispatch(None, {"op": "ping"})
+        assert s.seen == [("ping", True)]
+
+    def test_failed_frame_hook_sees_the_frame(self):
+        """A frame that fails validation produced no sub-replies —
+        the hook observes the frame itself, exactly once."""
+        s = _HookServer("127.0.0.1", 0)
+        s._dispatch(None, {"op": "batch", "ops": []})
+        assert s.seen == [("batch", False)]
+
+
+# ---------------------------------------------------------------------
+class TestBatchTCP:
+    def test_one_frame_one_reply_line(self, srv):
+        srv.start()
+        try:
+            with socket.create_connection(
+                    ("127.0.0.1", srv.port), timeout=5) as c:
+                f = c.makefile("rb")
+                frame = {"op": "batch", "id": 1, "ops": [
+                    {"op": "echo", "v": i} for i in range(5)]}
+                c.sendall(json.dumps(frame).encode() + b"\n")
+                resp = json.loads(f.readline())
+                assert resp["ok"] and resp["n"] == 5
+                assert resp["id"] == 1
+                assert [r["v"] for r in resp["replies"]] \
+                    == list(range(5))
+                # the connection survives a partial-failure frame
+                frame = {"op": "batch", "ops": [
+                    {"op": "nope"}, {"op": "ping"}]}
+                c.sendall(json.dumps(frame).encode() + b"\n")
+                resp = json.loads(f.readline())
+                assert resp["ok"] and resp["failed"] == 1
+                assert resp["replies"][1]["ok"]
+        finally:
+            srv.stop()
+
+    def test_oversize_frame_error_then_close(self, srv):
+        """max_line bounds the FRAME exactly as a single request:
+        one clean oversize error, then close."""
+        srv.max_line = 512
+        srv.start()
+        try:
+            with socket.create_connection(
+                    ("127.0.0.1", srv.port), timeout=5) as c:
+                ops = [{"op": "echo", "v": "x" * 64}
+                       for _ in range(32)]
+                c.sendall(json.dumps(
+                    {"op": "batch", "ops": ops}).encode() + b"\n")
+                f = c.makefile("rb")
+                resp = json.loads(f.readline())
+                assert resp["ok"] is False
+                assert "exceeds" in resp["error"]
+                assert f.readline() == b""
+        finally:
+            srv.stop()

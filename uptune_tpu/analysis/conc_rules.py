@@ -282,10 +282,37 @@ class AckBeforeDurable(Rule):
            "the commit record must be appended BEFORE the reply is "
            "written.  A handler that commits and returns a value "
            "without a drain/append between loses exactly the epochs "
-           "clients believe are safe.")
+           "clients believe are safe.  Split-phase appliers follow the "
+           "repo's `*_locked` convention: a method named `*_locked` "
+           "that commits is the under-lock half (the caller holds the "
+           "lock and owns the reply), so the drain obligation moves to "
+           "its call sites — each call to such a method counts as a "
+           "commit in the calling function.")
 
     _COMMIT_ATTRS = {"_commit", "commit"}
     _DRAIN_ATTRS = {"_drain_ckpt", "drain_ckpt"}
+    _LOCKED_SUFFIX = "_locked"
+
+    def _commit_carriers(self, mod: ModuleCtx):
+        """Names of `*_locked` methods whose body commits: the locked
+        half of a split-phase tell.  Exempt from the in-function check
+        (they return apply results to a lock-holding caller, not a
+        wire reply) — but calls TO them are commits, so every caller
+        inherits the drain-before-ack obligation."""
+        carriers = set()
+        for fn in mod.jit.functions:
+            name = getattr(fn, "name", "")
+            if not name.endswith(self._LOCKED_SUFFIX):
+                continue
+            for node in shallow_walk(function_body(fn)):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in self._COMMIT_ATTRS:
+                    rec = mod.plain_dotted(node.func.value) or ""
+                    if rec == "self" or rec.startswith("self."):
+                        carriers.add(name)
+                        break
+        return carriers
 
     @staticmethod
     def _in_scope(mod: ModuleCtx) -> bool:
@@ -298,10 +325,13 @@ class AckBeforeDurable(Rule):
     def check(self, mod: ModuleCtx):
         if not self._in_scope(mod):
             return
+        carriers = self._commit_carriers(mod)
         for fn in mod.jit.functions:
             name = getattr(fn, "name", "")
             if name in self._COMMIT_ATTRS:
                 continue            # the commit primitive itself
+            if name in carriers:
+                continue            # locked half; callers own the drain
             commits: List[ast.Call] = []
             drains: List[ast.Call] = []
             returns: List[ast.Return] = []
@@ -316,7 +346,7 @@ class AckBeforeDurable(Rule):
                     continue
                 rec = mod.plain_dotted(node.func.value) or ""
                 a = node.func.attr
-                if a in self._COMMIT_ATTRS and (
+                if (a in self._COMMIT_ATTRS or a in carriers) and (
                         rec == "self" or rec.startswith("self.")):
                     commits.append(node)
                 elif a in self._DRAIN_ATTRS:

@@ -49,6 +49,8 @@ _LAZY = {
     "SessionServer": ("uptune_tpu.serve.server", "SessionServer"),
     "RequestError": ("uptune_tpu.serve.wire", "RequestError"),
     "WireServer": ("uptune_tpu.serve.wire", "WireServer"),
+    "WireReply": ("uptune_tpu.serve.wire", "WireReply"),
+    "encode_reply": ("uptune_tpu.serve.wire", "encode_reply"),
     "Router": ("uptune_tpu.serve.router", "Router"),
     "HashRing": ("uptune_tpu.serve.router", "HashRing"),
     "routing_key": ("uptune_tpu.serve.router", "routing_key"),

@@ -38,19 +38,39 @@ The one failure auto-resume surfaces instead of hiding: a ticket
 from an in-flight epoch a server CRASH destroyed (the bounded-loss
 contract) fails with a "restored" ServeError — re-``ask()`` and
 retry with the fresh tickets.
+
+Batched wire plane (ISSUE 20): ``SessionClient.batch(payloads)``
+sends one multi-op frame (one round trip, ordered reply list,
+per-sub-op error ENTRIES); ``ask_many``/``tell_many`` drive many
+sessions' hot ops through one frame.  A torn frame replays whole
+under auto-resume — every sub-op carries the resume protocol's
+idempotency tags (replayed asks gain ``reissue``), so the replay is
+idempotent by construction.  Down-level servers are sniffed from the
+unknown-op error reply once (one loud log) and the client falls back
+to sequential requests / the legacy ``tell``+``results`` spelling.
 """
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Union
+from typing import (Any, Dict, Iterable, List, NamedTuple, Optional,
+                    Sequence, Tuple, Union)
 
 from .. import obs
 from ..obs.ship import backoff_jitter
 from ..utils.net import reject_self_connect
+
+log = logging.getLogger("uptune_tpu")
+
+# one reusable encoder for every request this process writes (the
+# serve/wire reply-side twin): json.dumps re-resolves its options on
+# every call, measurable at batched-frame request rates
+_ENC = json.JSONEncoder(separators=(",", ":"),
+                        check_circular=False).encode
 
 
 class ServeError(RuntimeError):
@@ -81,6 +101,20 @@ def _parse_addr(addr: Union[str, tuple, None]) -> tuple:
     if not host:
         raise ValueError(f"address must be 'host:port', got {addr!r}")
     return (host, int(port))
+
+
+def _mark_reissue(payload: Dict[str, Any]) -> None:
+    """Stamp a replayed request's ask(s) with ``reissue`` so tickets
+    the lost reply already handed out are re-offered, never re-minted
+    — for a batch frame, every ask sub-op is stamped (the torn-frame
+    replay is idempotent by construction: tells carry epoch+incarn
+    tags, asks reissue, and everything else is naturally replayable)."""
+    if payload.get("op") == "ask":
+        payload["reissue"] = True
+    elif payload.get("op") == "batch":
+        for sub in payload.get("ops") or ():
+            if isinstance(sub, dict) and sub.get("op") == "ask":
+                sub["reissue"] = True
 
 
 def connect(addr: Union[str, tuple, None] = None,
@@ -128,6 +162,11 @@ class SessionClient:
         # connection owns them server-side
         self._resume_ids: List[str] = []
         self.reconnects = 0
+        # down-level server sniffing (ISSUE 20): None = unknown,
+        # True = the server speaks it, False = fell back (one loud
+        # log at the flip, then quiet sequential/legacy compat)
+        self._batch_ok: Optional[bool] = None
+        self._tell_many_ok: Optional[bool] = None
         # redirect hops followed (the sharded front tier, ISSUE 17):
         # a router answers open/attach with {"redirect": "host:port"}
         # and the client re-homes the whole connection onto the
@@ -185,9 +224,7 @@ class SessionClient:
                     "connection desynced by an interrupted request; "
                     "reconnect")
             try:
-                self._f.write(json.dumps(payload,
-                                         separators=(",", ":"))
-                              .encode() + b"\n")
+                self._f.write(_ENC(payload).encode() + b"\n")
                 self._f.flush()
                 line = self._f.readline()
             except BaseException as e:
@@ -279,8 +316,7 @@ class SessionClient:
                             self._connect()
                             self.reconnects += 1
                             self._reattach()
-                    if payload.get("op") == "ask":
-                        payload["reissue"] = True
+                    _mark_reissue(payload)
                 resp = self._exchange(payload)
                 target = resp.get("redirect")
                 if isinstance(target, str) and target:
@@ -310,6 +346,135 @@ class SessionClient:
                 # restarted server in lockstep)
                 time.sleep(backoff_jitter(backoff))
                 backoff = min(self.backoff_max, backoff * 2)
+
+    # -- batched wire plane (ISSUE 20) ---------------------------------
+    def _note_downlevel(self, what: str) -> None:
+        """One loud log the first time a down-level server is sniffed;
+        the compat fallback stays quiet after that."""
+        log.warning(
+            "[ut-client] server %s:%d does not speak %r (pre-batched "
+            "wire plane); falling back to the legacy spelling for "
+            "this connection", self.host, self.port, what)
+
+    def batch(self, payloads: Sequence[Dict[str, Any]]
+              ) -> List[Dict[str, Any]]:
+        """Send many requests as ONE multi-op frame: one round trip,
+        one ordered reply list.  Each payload is a full request dict
+        (``{"op": ..., ...}``); each reply is that sub-op's full
+        response — per-sub-op failures come back as ``ok=False``
+        ENTRIES, never raised, so one bad sub-op cannot discard its
+        siblings' results.  Frame-level failures raise ServeError.
+
+        Under auto-resume a torn frame replays whole (see
+        _mark_reissue — idempotent by construction).  A server
+        without the batch op is sniffed from its unknown-op reply
+        (once, loudly) and the frame degrades to sequential requests.
+
+        Note: ``open``/``attach`` sub-ops are not registered for
+        auto-reattach — use ``open_session``/``attach_session`` for
+        sessions that must survive reconnects."""
+        if self._batch_ok is False:
+            return self._batch_fallback(payloads)
+        ops = [dict(p) for p in payloads]
+        try:
+            resp = self.request("batch", ops=ops)
+        except ServeError as e:
+            if (self._batch_ok is None
+                    and "unknown op" in str(e)):
+                self._batch_ok = False
+                self._note_downlevel("batch")
+                return self._batch_fallback(payloads)
+            raise
+        self._batch_ok = True
+        replies = resp.get("replies")
+        if not isinstance(replies, list) or len(replies) != len(ops):
+            raise ServeError(
+                f"batch reply carries {len(replies or ())} replies "
+                f"for {len(ops)} ops")
+        return replies
+
+    def _batch_fallback(self, payloads: Sequence[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+        """The down-level degradation: one request per payload,
+        errors folded into ok=False entries (the frame's element-wise
+        contract, minus the single round trip)."""
+        out: List[Dict[str, Any]] = []
+        for p in payloads:
+            op = p.get("op")
+            fields = {k: v for k, v in p.items() if k != "op"}
+            if op == "tell_many" and self._tell_many_ok is False:
+                # the server is already known down-level: go straight
+                # to the legacy tell+results spelling (same fields)
+                op = "tell"
+            try:
+                out.append(self.request(op, **fields))
+                continue
+            except ServeError as e:
+                if (op == "tell_many" and self._tell_many_ok is not
+                        False and "unknown op" in str(e)):
+                    self._tell_many_ok = False
+                    self._note_downlevel("tell_many")
+                    try:
+                        out.append(self.request("tell", **fields))
+                        continue
+                    except ServeError as e2:
+                        e = e2
+                out.append({"ok": False, "error": str(e)})
+        return out
+
+    def ask_many(self, handles: Sequence["SessionHandle"],
+                 n: int = 1) -> List[List["Trial"]]:
+        """One batched ask across many sessions: a single width-k
+        frame replaces k round trips (the per-shard ceiling lever
+        BENCH_SERVE's batched_wire phase prices).  Returns each
+        handle's trials in order; a failed sub-ask raises."""
+        replies = self.batch([{"op": "ask", "session": h.id,
+                               "n": int(n)} for h in handles])
+        out = []
+        for h, r in zip(handles, replies):
+            if not r.get("ok"):
+                raise ServeError(r.get("error",
+                                       "unknown server error"))
+            out.append(h._absorb_ask(r))
+        return out
+
+    def tell_many(self, batches: Sequence[
+            Tuple["SessionHandle", Iterable[Tuple[int, Any]]]]
+                  ) -> List[Dict[str, Any]]:
+        """One batched tell across many sessions: each (handle,
+        results) pair becomes one vectorized ``tell_many`` sub-op —
+        one frame, one reply per session, every tell acked behind its
+        session's single durable drain.  A failed sub-op raises;
+        per-TICKET failures stay element-wise inside each reply's
+        ``errors`` list."""
+        payloads, hs, tks = [], [], []
+        for h, results in batches:
+            rows = h._tell_rows(results)
+            payloads.append({"op": "tell_many", "session": h.id,
+                             "results": rows, "incarn": h.incarn})
+            hs.append(h)
+            tks.append([r["ticket"] for r in rows])
+        replies = self.batch(payloads)
+        out = []
+        for h, tickets, r in zip(hs, tks, replies):
+            if not r.get("ok"):
+                raise ServeError(r.get("error",
+                                       "unknown server error"))
+            h._after_tell(r, tickets)
+            out.append(r)
+        return out
+
+    def resolve(self, spaces: Sequence[Any]) -> List[Dict[str, Any]]:
+        """Against a router: map many spaces to their owning shards
+        in ONE round trip (each entry a Space or a list of param
+        records) — open each session directly against its shard
+        afterwards instead of paying a redirect hop per open.
+        Returns one ``{"shard", "addr", "key"}`` row per entry
+        (``{"error"}`` rows element-wise)."""
+        from ..exec.space_io import records_from_space
+        recs = [list(s) if isinstance(s, (list, tuple))
+                else records_from_space(s) for s in spaces]
+        return self.request("resolve", spaces=recs)["resolved"]
 
     # -- surface -------------------------------------------------------
     def ping(self) -> Dict[str, Any]:
@@ -401,8 +566,10 @@ class SessionHandle:
         self.store_served = 0
         self._ticket_epoch: Dict[int, int] = {}
 
-    def ask(self, n: int = 1) -> List[Trial]:
-        resp = self.client.request("ask", session=self.id, n=int(n))
+    def _absorb_ask(self, resp: Dict[str, Any]) -> List[Trial]:
+        """Fold one ask reply into this handle's resume bookkeeping
+        (version, incarnation, per-ticket epoch tags) — shared by the
+        single-request path and the batched-frame path."""
         self.version = resp.get("version", self.version)
         self.incarn = resp.get("incarn", self.incarn)
         self.store_served = resp.get("store_served", self.store_served)
@@ -413,10 +580,26 @@ class SessionHandle:
             self._ticket_epoch[t.ticket] = t.epoch
         return out
 
+    def ask(self, n: int = 1) -> List[Trial]:
+        return self._absorb_ask(
+            self.client.request("ask", session=self.id, n=int(n)))
+
+    def ask_many(self, n: int) -> List[Trial]:
+        """`ask(n)` under its batched-plane name: a single ask is
+        already k-wide in one round trip (the server issues the k
+        tickets in one group-lock hold).  Cross-SESSION batching is
+        where frames earn their keep — see SessionClient.ask_many."""
+        return self.ask(n)
+
     def _after_tell(self, resp: Dict[str, Any], tickets) -> None:
         self.version = resp.get("version", self.version)
         for t in tickets:
             self._ticket_epoch.pop(t, None)
+
+    def _tell_rows(self, results) -> List[Dict[str, Any]]:
+        return [{"ticket": int(t), "qor": q,
+                 "epoch": self._ticket_epoch.get(int(t))}
+                for t, q in results]
 
     def tell(self, ticket: int, qor: Optional[float],
              dur: float = 0.0) -> Dict[str, Any]:
@@ -429,12 +612,31 @@ class SessionHandle:
         return resp
 
     def tell_many(self, results) -> Dict[str, Any]:
-        """Report many (ticket, qor) pairs in ONE round trip."""
-        rows = [{"ticket": int(t), "qor": q,
-                 "epoch": self._ticket_epoch.get(int(t))}
-                for t, q in results]
-        resp = self.client.request("tell", session=self.id,
-                                   results=rows, incarn=self.incarn)
+        """Report many (ticket, qor) pairs in ONE round trip over the
+        vectorized ``tell_many`` op: the server applies the whole
+        batch in one group-lock hold and acks it behind one durable
+        drain (ISSUE 20).  A server predating the op is sniffed from
+        its unknown-op reply (once, loudly) and this handle's batches
+        ride the legacy ``tell``+``results`` spelling instead."""
+        rows = self._tell_rows(results)
+        c = self.client
+        if c._tell_many_ok is False:
+            resp = c.request("tell", session=self.id, results=rows,
+                             incarn=self.incarn)
+        else:
+            try:
+                resp = c.request("tell_many", session=self.id,
+                                 results=rows, incarn=self.incarn)
+                c._tell_many_ok = True
+            except ServeError as e:
+                if (c._tell_many_ok is None
+                        and "unknown op" in str(e)):
+                    c._tell_many_ok = False
+                    c._note_downlevel("tell_many")
+                    resp = c.request("tell", session=self.id,
+                                     results=rows, incarn=self.incarn)
+                else:
+                    raise
         self._after_tell(resp, [r["ticket"] for r in rows])
         return resp
 

@@ -655,6 +655,59 @@ class Router(WireServer):
         return {"shard": shard.name, "addr": shard.addr,
                 "key": key[:12]}
 
+    # one resolve's fan-out bound: the request side is capped by the
+    # wire max_line, but k tiny keys resolve to k shard rows
+    MAX_RESOLVE = 1024
+
+    def _op_resolve(self, req: dict) -> dict:
+        """Multi-signature resolve (ISSUE 20): many space-record
+        lists (``spaces``) or precomputed routing keys (``keys``) to
+        their owning shards in ONE round trip — a client opening many
+        sessions against the sharded tier maps them all first instead
+        of paying one redirect RTT per open.  Element-wise error
+        walls: one malformed entry yields an error ROW, the rest
+        still resolve."""
+        spaces = req.get("spaces")
+        keys = req.get("keys")
+        if spaces is not None:
+            if not isinstance(spaces, list):
+                raise RequestError("'spaces' must be a list of space "
+                                   "record lists")
+            entries: List[Any] = spaces
+            use_keys = False
+        elif keys is not None:
+            if not isinstance(keys, list):
+                raise RequestError("'keys' must be a list of routing "
+                                   "keys")
+            entries = keys
+            use_keys = True
+        else:
+            raise RequestError("resolve needs 'spaces' or 'keys'")
+        if len(entries) > self.MAX_RESOLVE:
+            raise RequestError(
+                f"resolve carries {len(entries)} entries; capped at "
+                f"{self.MAX_RESOLVE}")
+        rows: List[Dict[str, Any]] = []
+        for ent in entries:
+            try:
+                if use_keys:
+                    if not isinstance(ent, str) or not ent:
+                        raise RequestError(
+                            "routing key must be a non-empty string")
+                    key = ent
+                else:
+                    if not isinstance(ent, list) or not ent:
+                        raise RequestError(
+                            "space records must be a non-empty list")
+                    key = routing_key(ent)
+                shard = self._shard_for_key(key)
+                rows.append({"shard": shard.name, "addr": shard.addr,
+                             "key": key[:12]})
+            except RequestError as e:
+                rows.append({"error": str(e)})
+        obs.count("route.resolves", len(rows))
+        return {"resolved": rows}
+
     def _op_shards(self, req: dict) -> dict:
         with self._lock:
             rows = [sh.row() for sh in self._shards.values()]
@@ -754,7 +807,8 @@ class Router(WireServer):
                 "hub": self.hub._op_stats({})}
 
     _OPS = {"ping": _op_ping, "open": _op_open, "attach": _op_attach,
-            "route": _op_route, "shards": _op_shards,
+            "route": _op_route, "resolve": _op_resolve,
+            "shards": _op_shards,
             "scale": _op_scale, "metrics": _op_metrics,
             "sources": _op_sources, "health": _op_health,
             "stats": _op_stats}

@@ -49,7 +49,9 @@ from ..store.store import ResultStore
 from .durable import CheckpointLog, decode_raw, default_checkpoint_dir
 from .group import SessionGroup, group_key
 from .session import Session, StaleTicketError
-from .wire import RequestError, WireServer  # noqa: F401  (re-export)
+from .wire import (RequestError, WireReply,  # noqa: F401  (re-export)
+                   WireServer)
+from .wire import _ENC as _enc
 
 log = logging.getLogger("uptune_tpu")
 
@@ -447,69 +449,77 @@ class SessionServer(WireServer):
         obs.observe("serve.ask_ms", (time.perf_counter() - t0) * 1e3)
         if reissued:
             obs.count("serve.reissues")
-        return {"trials": [{"ticket": o.ticket, "config": o.config,
-                            "epoch": o.epoch}
-                           for o in offers],
-                "version": sess.version,
-                "store_served": sess.store_served,
-                "incarn": sess.incarn, "reissued": reissued}
+        version, served = sess.version, sess.store_served
+        incarn = sess.incarn
+        out = WireReply(
+            ok=True,
+            trials=[{"ticket": o.ticket, "config": o.config,
+                     "epoch": o.epoch} for o in offers],
+            version=version, store_served=served,
+            incarn=incarn, reissued=reissued)
+        if all(o.canon is not None for o in offers):
+            # preserialized reply (ISSUE 20): each offer's canonical
+            # config JSON was computed once, at the epoch's dedup
+            # scan — a k-wide ask splices k cached fragments instead
+            # of re-encoding k config dicts, and a batch frame
+            # splices this whole text in turn.  canon_config is
+            # value-identical to the raw dict for wire-decoded
+            # configs (sorted keys only), so text == dict holds.
+            rows = ",".join(
+                '{"ticket":%d,"config":%s,"epoch":%d}'
+                % (o.ticket, o.canon, o.epoch) for o in offers)
+            out.wire_text = (
+                '{"ok":true,"trials":[%s],"version":%d,'
+                '"store_served":%d,"incarn":%s,"reissued":%s}'
+                % (rows, version, served, _enc(incarn),
+                   "true" if reissued else "false"))
+        return out
 
     def _op_tell(self, req: dict) -> dict:
         """Single tell (`ticket` + `qor`) or a batch in one round trip
         (`results`: list of {ticket, qor[, dur]} objects) — a tenant
-        measuring trials in parallel reports them all at once."""
-        sess = self._session(req)
-        is_batch = "results" in req
-        if is_batch:
-            batch = req["results"]
-            if not isinstance(batch, list):
-                raise RequestError("'results' must be a list")
-        elif "ticket" in req:
-            batch = [req]
-        else:
+        measuring trials in parallel reports them all at once.  The
+        `results` form is the legacy spelling of `tell_many` and
+        routes through the same vectorized one-lock-hold path."""
+        if "results" in req:
+            return self._op_tell_many(req)
+        if "ticket" not in req:
             raise RequestError("tell needs 'ticket' or 'results'")
+        sess = self._session(req)
         t0 = time.perf_counter()
-        incarn = req.get("incarn")
-        out: Dict[str, Any] = {"told": 0, "new_best": False,
-                               "committed": False, "duplicates": 0}
-        # a batch applies element-wise: one bad/stale ticket must not
-        # discard the progress of the others (they are already told
-        # server-side — reporting ok=False would strand the epoch).
-        # Per-element failures come back in `errors`; a SINGLE tell
-        # keeps the hard ok=False contract.
-        errors: List[Dict[str, Any]] = []
-        for r in batch:
-            try:
-                one = sess.tell(int(r["ticket"]), r.get("qor"),
-                                float(r.get("dur", 0.0)),
-                                epoch=r.get("epoch"), incarn=incarn)
-            except StaleTicketError as e:
-                if not is_batch:
-                    raise RequestError(str(e))
-                errors.append({"ticket": r.get("ticket"),
-                               "error": str(e)})
-                continue
-            except (KeyError, TypeError, ValueError,
-                    AttributeError) as e:
-                if not is_batch:
-                    raise RequestError(f"bad tell payload: {e}")
-                errors.append({"ticket": (r.get("ticket")
-                                          if isinstance(r, dict)
-                                          else None),
-                               "error": f"bad tell payload: {e}"})
-                continue
-            if one.get("duplicate"):
-                # a resume replay the session squashed: already
-                # applied (and, when committed, already durable) —
-                # not a fresh tell, but its epoch outcome still counts
-                out["duplicates"] += 1
-            else:
-                out["told"] += 1
-                out["new_best"] = out["new_best"] or one["new_best"]
-            out["committed"] = out["committed"] or one["committed"]
-            out["version"] = one["version"]
-        if errors:
-            out["errors"] = errors
+        # a SINGLE tell keeps the hard ok=False contract: a stale or
+        # malformed tell is the whole op's error
+        try:
+            one = sess.tell(int(req["ticket"]), req.get("qor"),
+                            float(req.get("dur", 0.0)),
+                            epoch=req.get("epoch"),
+                            incarn=req.get("incarn"))
+        except StaleTicketError as e:
+            raise RequestError(str(e))
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise RequestError(f"bad tell payload: {e}")
+        dup = bool(one.get("duplicate"))
+        out = {"told": 0 if dup else 1,
+               "new_best": False if dup else one["new_best"],
+               "committed": one["committed"],
+               "duplicates": 1 if dup else 0,
+               "version": one["version"]}
+        obs.observe("serve.tell_ms", (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def _op_tell_many(self, req: dict) -> dict:
+        """The vectorized batch tell (ISSUE 20): every row applies in
+        ONE group-lock hold and the whole batch is acked behind ONE
+        checkpoint drain.  Element-wise error walls — one bad/stale
+        ticket must not discard the progress of the others (they are
+        already told server-side; reporting ok=False would strand the
+        epoch): per-row failures come back in `errors`."""
+        sess = self._session(req)
+        batch = req.get("results")
+        if not isinstance(batch, list):
+            raise RequestError("'results' must be a list")
+        t0 = time.perf_counter()
+        out = sess.tell_many(batch, incarn=req.get("incarn"))
         obs.observe("serve.tell_ms", (time.perf_counter() - t0) * 1e3)
         return out
 
@@ -627,7 +637,8 @@ class SessionServer(WireServer):
         return out
 
     _OPS = {"ping": _op_ping, "open": _op_open, "attach": _op_attach,
-            "ask": _op_ask, "tell": _op_tell, "best": _op_best,
+            "ask": _op_ask, "tell": _op_tell,
+            "tell_many": _op_tell_many, "best": _op_best,
             "close": _op_close, "metrics": _op_metrics,
             "stats": _op_stats, "health": _op_health}
 

@@ -53,10 +53,15 @@ class TrialOffer(NamedTuple):
     the serving counters report them; ask() returns only live
     tickets.)  `epoch` is the session version the ticket was issued
     against — carried back by resuming clients so a duplicate tell
-    replay is detected server-side (ISSUE 15)."""
+    replay is detected server-side (ISSUE 15).  `canon` is the
+    config's canonical JSON text, computed once per epoch for the
+    dedup scan and reused by the server's preserialized ask reply
+    (ISSUE 20) — None for offers minted off paths that never
+    canonicalized (LocalSession callers ignore it)."""
     ticket: int
     config: Dict[str, Any]
     epoch: int = 0
+    canon: Optional[str] = None
 
 
 class _Pending(object):
@@ -74,8 +79,8 @@ class _Pending(object):
     build the same config twice in one batch)."""
 
     __slots__ = ("epoch", "version", "configs", "raw", "filled",
-                 "next_row", "by_canon", "group_rows", "group_value",
-                 "tickets", "told")
+                 "next_row", "by_canon", "group_canon", "group_rows",
+                 "group_value", "tickets", "told")
 
     def __init__(self, epoch, version: int, configs: List[dict]):
         self.epoch = epoch
@@ -86,6 +91,7 @@ class _Pending(object):
         self.filled = np.zeros((b,), bool)
         self.next_row = 0                       # lazy scan cursor
         self.by_canon: Dict[str, int] = {}      # canon -> dup-group
+        self.group_canon: List[str] = []        # dup-group -> canon
         self.group_rows: List[List[int]] = []
         self.group_value: List[Optional[float]] = []
         self.tickets: Dict[int, int] = {}       # ticket id -> dup-group
@@ -200,6 +206,7 @@ class Session:
             return None                     # else: fills at its tell
         g = len(p.group_rows)
         p.by_canon[c] = g
+        p.group_canon.append(c)
         p.group_rows.append([r])
         row = self.store.lookup(cfg) if self.store is not None else None
         if row is not None:
@@ -215,7 +222,7 @@ class Session:
         t = self._ticket_seq
         self._ticket_seq += 1
         p.tickets[t] = g
-        return TrialOffer(t, cfg, p.version)
+        return TrialOffer(t, cfg, p.version, c)
 
     def _commit(self) -> None:
         p = self.pending
@@ -287,35 +294,43 @@ class Session:
         come back when the epoch's remaining rows are already ticketed
         out (tell those first).  An epoch refresh only ENQUEUES device
         work under the group lock (group.pending_for); the blocking
-        host pull + config decode run unlocked (_new_pending)."""
+        host pull + config decode run unlocked (_new_pending).
+
+        The fast path — k tickets off an already-materialized epoch —
+        is ONE group-lock hold (ISSUE 20): open-check, row scan and
+        ticket mint happen in the same acquisition, so a k-wide ask
+        costs one lock round instead of k."""
         out: List[TrialOffer] = []
         autos = 0
         while not out:
+            need_epoch = False
             with self.group.lock:
                 self._check_open()
                 p = self.pending
-            if p is None:
-                p = self._new_pending()
                 if p is None:
-                    continue        # raced a concurrent driver; retry
-            with self.group.lock:
-                if self.pending is not p:
-                    continue        # committed under us; take the next
-                while p.next_row < len(p.configs) and len(out) < n:
-                    offer = self._scan_row(p)
-                    if offer is not None:
-                        out.append(offer)
-                if out:
-                    self.asks += len(out)
-                    break
-                if p.settled():
-                    # every row memo-served: publish and move on
-                    self._commit()
-                    autos += 1
-                    if autos >= max_auto:
+                    need_epoch = True
+                else:
+                    while p.next_row < len(p.configs) and len(out) < n:
+                        offer = self._scan_row(p)
+                        if offer is not None:
+                            out.append(offer)
+                    if out:
+                        self.asks += len(out)
                         break
-                    continue
-                break   # remaining rows already ticketed: tell first
+                    if p.settled():
+                        # every row memo-served: publish and move on
+                        self._commit()
+                        autos += 1
+                        if autos >= max_auto:
+                            break
+                        continue
+                    break   # rows already ticketed out: tell first
+            if need_epoch:
+                # the expensive host side (device pull + config
+                # decode) runs UNLOCKED; the next loop pass re-reads
+                # self.pending under the lock, so a concurrent commit
+                # between here and there is simply retried
+                self._new_pending()
         obs.count("serve.asks", len(out))
         # memo auto-commits above published versions: durable-ack them
         # before this ask's reply, same rule as the tell path
@@ -333,7 +348,7 @@ class Session:
             if p is None:
                 return []
             return [TrialOffer(t, p.configs[p.group_rows[g][0]],
-                               p.version)
+                               p.version, p.group_canon[g])
                     for t, g in sorted(p.tickets.items())]
 
     def _squash_duplicate(self, p: Optional[_Pending], ticket: int,
@@ -369,58 +384,59 @@ class Session:
                     "version": self.version, "duplicate": True}
         return None
 
-    def tell(self, ticket: int, qor: Optional[float],
-             dur: float = 0.0, epoch=None, incarn=None
-             ) -> Dict[str, Any]:
-        """Report a ticket's USER-oriented QoR (None/NaN/inf = build
-        failure).  The tell completing the epoch publishes the next
-        snapshot version.  `epoch`/`incarn` are the resume protocol's
-        idempotence tags (the ticket's TrialOffer.epoch and the ask
-        reply's incarnation token): a duplicate replay after an
-        acked-but-unobserved reply is detected and squashed instead
-        of raising or double-applying."""
-        with self.group.lock:
-            self._check_open()
-            p = self.pending
-            # a ticket carrying a stale incarnation token must NEVER
-            # apply, even if its id coincides with a live ticket (the
-            # restored id space is offset — _mark_restored — so this
-            # is a belt, not the wall)
-            stale_inc = (incarn is not None
-                         and str(incarn) != self.incarn)
-            if p is None or ticket not in p.tickets or stale_inc:
-                dup = self._squash_duplicate(p, ticket, epoch, incarn)
-                if dup is not None:
-                    obs.count("serve.dup_tells")
-                    return dup
-                raise StaleTicketError(
-                    f"ticket {ticket} is unknown, already told, or "
-                    f"from a published-over epoch (session "
-                    f"{self.id}, version {self.version})")
-            # convert BEFORE popping: a malformed qor (string, list)
-            # must leave the ticket live for a retry, not consume it
-            # and strand the epoch one row short of settled forever
-            v = float("nan") if qor is None else float(qor)
-            g = p.tickets.pop(ticket)
-            p.told.add(ticket)
-            finite = v == v and abs(v) != float("inf")
-            p.group_value[g] = v if finite else float("nan")
-            p.fill(g, p.group_value[g])
-            cfg = p.configs[p.group_rows[g][0]]
-            new_best = False
-            if finite:
-                new_best = self._offer_best(cfg, v)
-            self.tells += 1
-            self.quality.on_tell(finite, new_best)
-            committed = False
-            if p.settled():
-                self._commit()
-                committed = True
-            version = self.version
-        # durable-before-ack: the commit record (if this tell
-        # published) hits disk before this method returns a
-        # committed=true the client could act on
-        self._drain_ckpt()
+    def _tell_locked(self, ticket: int, qor, epoch, incarn):
+        """Apply ONE tell under the group lock (caller holds it).
+        Returns ``(result, fx)`` where ``fx`` is None for a squashed
+        duplicate, else the ``(cfg, value, finite, new_best,
+        committed)`` tuple the caller's unlocked side effects (journal
+        row, store memo write) need.  Raises StaleTicketError /
+        conversion errors exactly like the historical tell body —
+        batch callers turn those into per-element error entries."""
+        self._check_open()
+        p = self.pending
+        # a ticket carrying a stale incarnation token must NEVER
+        # apply, even if its id coincides with a live ticket (the
+        # restored id space is offset — _mark_restored — so this
+        # is a belt, not the wall)
+        stale_inc = (incarn is not None
+                     and str(incarn) != self.incarn)
+        if p is None or ticket not in p.tickets or stale_inc:
+            dup = self._squash_duplicate(p, ticket, epoch, incarn)
+            if dup is not None:
+                obs.count("serve.dup_tells")
+                return dup, None
+            raise StaleTicketError(
+                f"ticket {ticket} is unknown, already told, or "
+                f"from a published-over epoch (session "
+                f"{self.id}, version {self.version})")
+        # convert BEFORE popping: a malformed qor (string, list)
+        # must leave the ticket live for a retry, not consume it
+        # and strand the epoch one row short of settled forever
+        v = float("nan") if qor is None else float(qor)
+        g = p.tickets.pop(ticket)
+        p.told.add(ticket)
+        finite = v == v and abs(v) != float("inf")
+        p.group_value[g] = v if finite else float("nan")
+        p.fill(g, p.group_value[g])
+        cfg = p.configs[p.group_rows[g][0]]
+        new_best = False
+        if finite:
+            new_best = self._offer_best(cfg, v)
+        self.tells += 1
+        self.quality.on_tell(finite, new_best)
+        committed = False
+        if p.settled():
+            self._commit()
+            committed = True
+        return ({"new_best": new_best, "committed": committed,
+                 "version": self.version},
+                (cfg, v, finite, new_best, committed, self.version))
+
+    def _tell_fx(self, fx, dur: float) -> None:
+        """One applied tell's unlocked side effects: the journal row
+        and the cross-tenant memo write — disk stays off the group's
+        serving path."""
+        cfg, v, finite, new_best, committed, version = fx
         if obs.journal.enabled():
             # the server-side tuning journal (per-tenant stream): one
             # row per committed tell, so `ut report` over a server's
@@ -433,11 +449,11 @@ class Session:
                 version=version)
         # the memo write happens OUTSIDE the group lock (the store has
         # its own lock; a racing reader either hits or re-measures —
-        # never a correctness matter), keeping disk appends off the
-        # group's serving path.  Best-effort to the end: the tell is
-        # already applied above, so a failed append (disk full, store
-        # closed by a racing stop) must not fail the response — that
-        # would report ok=False for an epoch that really committed
+        # never a correctness matter).  Best-effort to the end: the
+        # tell is already applied, so a failed append (disk full,
+        # store closed by a racing stop) must not fail the response —
+        # that would report ok=False for an epoch that really
+        # committed
         if self.store is not None:
             try:
                 self.store.record(cfg, v if finite else None, dur,
@@ -445,8 +461,82 @@ class Session:
             except OSError:
                 obs.count("serve.store_write_errors")
         obs.count("serve.tells")
-        return {"new_best": new_best, "committed": committed,
-                "version": version}
+
+    def tell(self, ticket: int, qor: Optional[float],
+             dur: float = 0.0, epoch=None, incarn=None
+             ) -> Dict[str, Any]:
+        """Report a ticket's USER-oriented QoR (None/NaN/inf = build
+        failure).  The tell completing the epoch publishes the next
+        snapshot version.  `epoch`/`incarn` are the resume protocol's
+        idempotence tags (the ticket's TrialOffer.epoch and the ask
+        reply's incarnation token): a duplicate replay after an
+        acked-but-unobserved reply is detected and squashed instead
+        of raising or double-applying."""
+        with self.group.lock:
+            out, fx = self._tell_locked(ticket, qor, epoch, incarn)
+        # durable-before-ack: the commit record (if this tell
+        # published) hits disk before this method returns a
+        # committed=true the client could act on
+        self._drain_ckpt()
+        if fx is not None:
+            self._tell_fx(fx, dur)
+        return out
+
+    def tell_many(self, rows: Sequence[Any], incarn=None
+                  ) -> Dict[str, Any]:
+        """Apply a batch of tells in ONE group-lock hold and ack them
+        all behind ONE checkpoint drain (ISSUE 20) — the vectorized
+        server op.  Each row is a ``{"ticket", "qor"[, "dur",
+        "epoch"]}`` object carrying its own epoch tag; `incarn` covers
+        the batch (one client, one incarnation).  Element-wise error
+        walls: a stale/malformed row becomes an ``errors`` entry and
+        the rest still apply — exactly the PR 15 duplicate-squash
+        matrix, row by row.  Ack-after-durable holds batch-wide: the
+        single ``_drain_ckpt`` below flushes EVERY version this batch
+        published before the one reply that acks it."""
+        out: Dict[str, Any] = {"told": 0, "new_best": False,
+                               "committed": False, "duplicates": 0,
+                               "version": self.version}
+        errors: List[Dict[str, Any]] = []
+        fxs: List[Any] = []
+        with self.group.lock:
+            for r in rows:
+                try:
+                    # convert dur (and ticket) BEFORE applying, so a
+                    # malformed row leaves its ticket live for retry
+                    dur = float(r.get("dur") or 0.0)
+                    one, fx = self._tell_locked(
+                        int(r["ticket"]), r.get("qor"),
+                        r.get("epoch"), incarn)
+                except StaleTicketError as e:
+                    errors.append({"ticket": r.get("ticket"),
+                                   "error": str(e)})
+                    continue
+                except (KeyError, TypeError, ValueError,
+                        AttributeError) as e:
+                    errors.append({"ticket": (r.get("ticket")
+                                              if isinstance(r, dict)
+                                              else None),
+                                   "error": f"bad tell payload: {e}"})
+                    continue
+                if one.get("duplicate"):
+                    out["duplicates"] += 1
+                else:
+                    out["told"] += 1
+                    out["new_best"] = (out["new_best"]
+                                       or one["new_best"])
+                    fxs.append((fx, dur))
+                out["committed"] = out["committed"] or one["committed"]
+                out["version"] = one["version"]
+        if errors:
+            out["errors"] = errors
+        # ONE durable drain acks the whole batch (every commit this
+        # batch buffered is on disk before the reply), then the
+        # unlocked per-tell side effects in application order
+        self._drain_ckpt()
+        for fx, dur in fxs:
+            self._tell_fx(fx, dur)
+        return out
 
     def best(self) -> Dict[str, Any]:
         """Host-side incumbent (never a device sync)."""
@@ -566,6 +656,9 @@ class LocalSession:
     def tell(self, ticket: int, qor: Optional[float],
              dur: float = 0.0) -> Dict[str, Any]:
         return self._session.tell(ticket, qor, dur)
+
+    def tell_many(self, rows: Sequence[Any]) -> Dict[str, Any]:
+        return self._session.tell_many(rows)
 
     def best(self) -> Dict[str, Any]:
         return self._session.best()

@@ -19,6 +19,24 @@ its op table:
   so clients may pipeline; an optional ``ctx`` span id is recorded as
   the handler span's ``parent`` so `ut-trace merge` joins
   client/server shards (docs/OBSERVABILITY.md).
+* **Batch frames** (ISSUE 20) — ``{"op": "batch", "ops": [...]}`` is
+  handled by the kernel itself, so every wire-speaking service
+  (``ut serve``, ``ut store``, ``ut hub``, ``ut route``) inherits it
+  without touching its op table: one socket read, one dispatch walk,
+  an ORDERED reply list written back as one coalesced send.  Each
+  sub-op keeps its own error wall — a malformed sub-op yields an
+  error *entry* in ``replies``, never a poisoned frame or connection
+  — and the whole frame is bounded by the same ``max_line`` cap as a
+  single request (one clean oversize error, then close).  Frames do
+  not nest, and ``max_batch_ops`` bounds reply amplification.
+* **Encode fast path** (ISSUE 20) — one module-cached
+  ``JSONEncoder`` serializes every reply (the obs/journal precedent:
+  ``json.dumps`` re-resolves its options per call), and a handler may
+  return a ``WireReply`` carrying its own preserialized wire text
+  (built from per-epoch cached canonical config JSON on the session
+  server's ask path) — the connection loop writes that text verbatim
+  and a batch frame splices sub-reply texts instead of re-encoding
+  k configs per k-wide ask.
 * **Connection plane** — since ISSUE 17 a single asyncio event loop
   (one ``-loop`` thread) owns accept + read + write for EVERY
   connection, replacing the thread-per-connection loops whose GIL
@@ -75,11 +93,53 @@ from ..obs import faults
 
 log = logging.getLogger("uptune_tpu")
 
-__all__ = ["RequestError", "WireServer"]
+__all__ = ["RequestError", "WireServer", "WireReply", "encode_reply"]
+
+# one reusable encoder for every reply this process writes — the
+# obs/journal measurement: ~25% cheaper per object than json.dumps,
+# which re-resolves its options on every call
+_ENC = json.JSONEncoder(separators=(",", ":"),
+                        check_circular=False).encode
 
 
 class RequestError(ValueError):
     """Bad request payload (reported to the client, never fatal)."""
+
+
+class WireReply(dict):
+    """A response dict that carries its own wire encoding.
+
+    The encode fast path: a handler that can assemble its reply from
+    preserialized fragments (the session server's ask path splices
+    per-epoch cached canonical config JSON) returns one of these with
+    ``wire_text`` set to the EXACT compact JSON of the dict —
+    including ``"ok"`` — and the connection loop writes the text
+    verbatim instead of re-encoding.  In-process consumers see a
+    plain dict; the text is invisible to them.  The text/dict
+    equivalence is a hard contract (tests assert
+    ``json.loads(encode_reply(r)) == dict(r)``)."""
+
+    __slots__ = ("wire_text",)
+
+
+def encode_reply(resp: dict) -> str:
+    """Compact JSON text of one response — the preserialized
+    ``wire_text`` when the handler provided one, the cached encoder
+    otherwise."""
+    t = getattr(resp, "wire_text", None)
+    if t is not None:
+        return t
+    return _ENC(resp)
+
+
+def _set_id(out: dict, rid: Any) -> None:
+    """Echo the client's ``id`` into a finished reply, keeping a
+    preserialized ``wire_text`` consistent: the echo is spliced in
+    before the closing brace, so the fast path survives pipelining."""
+    out["id"] = rid
+    t = getattr(out, "wire_text", None)
+    if t is not None:
+        out.wire_text = t[:-1] + ',"id":' + _ENC(rid) + "}"
 
 
 class WireServer:
@@ -100,6 +160,10 @@ class WireServer:
     # appends) lands so the event loop stays pure I/O; more workers
     # than cores only adds GIL pressure on this box
     MAX_WORKERS = 8
+    # sub-ops one batch frame may carry (ISSUE 20): the request side
+    # is already bounded by max_line, but k tiny sub-ops can fan out
+    # into k large replies — this bounds the amplification
+    MAX_BATCH_OPS = 256
 
     def __init__(self, host: str, port: int):
         self.host = str(host)
@@ -107,6 +171,7 @@ class WireServer:
         self.max_line = int(self.MAX_LINE)
         self.idle_timeout: Optional[float] = self.IDLE_TIMEOUT
         self.max_workers = int(self.MAX_WORKERS)
+        self.max_batch_ops = int(self.MAX_BATCH_OPS)
         self._lock = threading.RLock()
         self._listener: Optional[socket.socket] = None
         self._threads: List[threading.Thread] = []
@@ -149,7 +214,66 @@ class WireServer:
         trace context: the handler span records it as ``parent``, so
         a merged client+server trace joins each ``client.request``
         span to the ``serve.handle`` span it paid for — wire time is
-        the difference (docs/OBSERVABILITY.md)."""
+        the difference (docs/OBSERVABILITY.md).
+
+        A ``batch`` frame is unpacked here, in the kernel, so every
+        subclass inherits multi-op frames with no op-table change."""
+        if isinstance(req, dict) and req.get("op") == "batch":
+            out = self._handle_batch(req)
+            rid = req.get("id")
+            if rid is not None:
+                _set_id(out, rid)
+            return out
+        return self._handle_one(req)
+
+    def _handle_batch(self, req: dict) -> dict:
+        """One multi-op frame: dispatch each sub-op through the SAME
+        per-op error wall a lone request gets, collect the ordered
+        reply list, and preserialize the frame by splicing the
+        sub-reply texts — sub-ops with cached wire text (the ask fast
+        path) are never re-encoded.  Never raises."""
+        ops = req.get("ops")
+        if not isinstance(ops, list) or not ops:
+            return {"ok": False,
+                    "error": "batch needs 'ops': a non-empty list of "
+                             "request objects"}
+        if len(ops) > self.max_batch_ops:
+            return {"ok": False,
+                    "error": f"batch carries {len(ops)} ops; this "
+                             f"server caps frames at "
+                             f"{self.max_batch_ops}"}
+        ctx = req.get("ctx")
+        replies: List[dict] = []
+        texts: List[str] = []
+        failed = 0
+        for sub in ops:
+            if not isinstance(sub, dict):
+                r: dict = {"ok": False,
+                           "error": "batch sub-op must be a JSON "
+                                    "object"}
+            elif sub.get("op") == "batch":
+                r = {"ok": False, "error": "batch frames do not nest"}
+            else:
+                if ctx is not None and "ctx" not in sub:
+                    # the frame's trace context covers sub-ops that
+                    # carry none of their own, so server spans still
+                    # join the client.request span the frame paid for
+                    sub = dict(sub, ctx=ctx)
+                r = self._handle_one(sub)
+            if not r.get("ok"):
+                failed += 1
+            replies.append(r)
+            texts.append(encode_reply(r))
+        obs.count("wire.batch_frames")
+        obs.count("wire.batch_ops", len(replies))
+        out = WireReply(ok=True, n=len(replies), failed=failed,
+                        replies=replies)
+        out.wire_text = ('{"ok":true,"n":%d,"failed":%d,"replies":[%s]}'
+                         % (len(replies), failed, ",".join(texts)))
+        return out
+
+    def _handle_one(self, req: Any) -> dict:
+        """Dispatch one (non-batch) request — the per-op error wall."""
         if not isinstance(req, dict):
             return {"ok": False, "error": "request must be a JSON "
                                           "object"}
@@ -169,7 +293,12 @@ class WireServer:
                 attrs["parent"] = str(ctx["span"])[:64]
             with obs.span(self.HANDLE_SPAN, **attrs) as sp:
                 try:
-                    out = {"ok": True, **fn(self, req)}
+                    res = fn(self, req)
+                    # a WireReply already carries "ok" (and its
+                    # preserialized text) — merging it into a fresh
+                    # dict would throw the fast path away
+                    out = (res if type(res) is WireReply
+                           else {"ok": True, **res})
                 except RequestError as e:
                     out = {"ok": False, "error": str(e)}
                     sp.set(error=True)
@@ -180,16 +309,26 @@ class WireServer:
                            "error": f"internal: {type(e).__name__}: {e}"}
                     sp.set(error=True)
         if rid is not None:
-            out["id"] = rid
+            _set_id(out, rid)
         return out
 
     def _dispatch(self, state: Any, req: dict) -> dict:
         """One request's worker-pool job: handler + response hook
         (the hook runs here, not on the loop, so a hook that blocks —
         the hub's durable timeline append — costs a worker slot, not
-        the whole connection plane)."""
+        the whole connection plane).  A batch frame fans the hook out
+        per sub-op: connection-scoped state (the session server's
+        ownership tracking keys on each sub-op's ``op``) must observe
+        every sub-request, never the opaque frame."""
         resp = self.handle(req)
-        self._on_response(state, req, resp)
+        if (isinstance(req, dict) and req.get("op") == "batch"
+                and resp.get("ok")):
+            for sub, r in zip(req.get("ops") or (),
+                              resp.get("replies") or ()):
+                if isinstance(sub, dict):
+                    self._on_response(state, sub, r)
+        else:
+            self._on_response(state, req, resp)
         return resp
 
     # -- TCP -----------------------------------------------------------
@@ -299,11 +438,11 @@ class WireServer:
                     # the oversized line is unread, so the stream
                     # cannot be re-synchronized
                     obs.count("wire.line_cap")
-                    writer.write(json.dumps(
+                    writer.write(_ENC(
                         {"ok": False,
                          "error": f"request line exceeds "
-                                  f"{self.max_line} bytes"},
-                        separators=(",", ":")).encode() + b"\n")
+                                  f"{self.max_line} bytes"}
+                    ).encode() + b"\n")
                     await writer.drain()
                     break
                 if not line:
@@ -324,8 +463,10 @@ class WireServer:
                     resp = await loop.run_in_executor(
                         self._pool, self._dispatch, state, req)
                 faults.fire("wire.reply")
-                writer.write(json.dumps(resp, separators=(",", ":"))
-                             .encode() + b"\n")
+                # one coalesced send per request — for a batch frame
+                # this is the spliced sub-reply texts in one line, and
+                # a WireReply's preserialized text goes out verbatim
+                writer.write(encode_reply(resp).encode() + b"\n")
                 await writer.drain()
         except (OSError, ValueError):
             pass            # client went away mid-exchange
